@@ -1,0 +1,25 @@
+"""consensus_specs_tpu — a TPU-native executable Ethereum PoS consensus-spec framework.
+
+Built from scratch with the capabilities of the reference executable spec
+(eth2spec, see /root/reference): SSZ type system + Merkleization, BLS12-381
+signature stack, per-fork executable beacon-chain specs (phase0/altair/bellatrix),
+fork choice, a conformance-test framework, and test-vector generators — with the
+hot path (batched signature verification, shuffling, epoch registry math,
+Merkleization) designed as JAX/XLA kernels over TPU meshes rather than scalar
+C-library calls.
+
+Layout:
+  ssz/       SSZ type zoo, flat serialization, batched Merkleization, proofs
+  crypto/    BLS12-381 fields/curves/pairing (pure-Python oracle) + shim
+  ops/       batched device kernels (sha256, shuffle, field limb arithmetic)
+  parallel/  mesh / sharding helpers (pjit / shard_map over jax.sharding.Mesh)
+  forks/     executable spec modules per fork x preset
+  config/    preset + runtime-config loading
+  utils/     host-side utilities (hash, caches)
+"""
+
+__version__ = "0.1.0"
+
+# Exact uint64 semantics in device code require x64 mode. Enabled lazily by the
+# modules that trace jax code (ops/, parallel/) so that pure-host users do not
+# pay the jax import cost.

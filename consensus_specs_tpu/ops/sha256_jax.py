@@ -1,0 +1,89 @@
+"""sha256 as a batched JAX/XLA kernel (TPU twin of ops/sha256_np.py).
+
+Operates on uint32 *word lanes* so the whole Merkle level / shuffle round is a
+single fused XLA computation: shape (N, 16) message-word blocks in, (N, 8)
+digest words out. The 64 rounds are unrolled at trace time (constant trip
+count, no data-dependent control flow) so XLA can software-pipeline them.
+
+Used by: ssz device Merkleization, the swap-or-not shuffle kernel
+(ops/shuffle.py), and randao/seed derivation inside the jitted epoch engine.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .sha256_np import _H0, _K
+
+
+def _rotr(x, n):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def _compress(state, w16):
+    """state: tuple of 8 (...,) uint32; w16: (..., 16) uint32 block words."""
+    w = [w16[..., t] for t in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> jnp.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> jnp.uint32(10))
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(int(_K[t])) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f = g, f, e
+        e = d + t1
+        d, c, b = c, b, a
+        a = t1 + t2
+    return tuple(s + v for s, v in zip(state, (a, b, c, d, e, f, g, h)))
+
+
+def _init_state(shape):
+    return tuple(jnp.full(shape, int(_H0[i]), dtype=jnp.uint32) for i in range(8))
+
+
+def sha256_1block(w16: jax.Array) -> jax.Array:
+    """sha256 of messages that fit one padded block. w16: (..., 16) pre-padded
+    message words (caller sets the 0x80... terminator and bit length).
+    Returns (..., 8) digest words."""
+    state = _compress(_init_state(w16.shape[:-1]), w16)
+    return jnp.stack(state, axis=-1)
+
+
+# Constant padding block for 64-byte messages: 0x80 then bitlen 512.
+_PAD64 = np.zeros(16, dtype=np.uint32)
+_PAD64[0] = 0x80000000
+_PAD64[15] = 512
+
+
+def sha256_64B_words(w16: jax.Array) -> jax.Array:
+    """Batched sha256 of 64-byte messages given as (..., 16) uint32 words
+    (Merkle parent hash: left_root_words || right_root_words). -> (..., 8)."""
+    state = _compress(_init_state(w16.shape[:-1]), w16)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD64), w16.shape[:-1] + (16,))
+    state = _compress(state, pad)
+    return jnp.stack(state, axis=-1)
+
+
+def merkle_parent_level(nodes: jax.Array) -> jax.Array:
+    """One Merkle level: (2N, 8) digest-word nodes -> (N, 8) parents."""
+    pairs = nodes.reshape(-1, 16)
+    return sha256_64B_words(pairs)
+
+
+def bytes_to_words(data: bytes) -> np.ndarray:
+    """Host helper: big-endian bytes -> uint32 word array (len % 4 == 0)."""
+    from .sha256_np import _bytes_to_words
+
+    return _bytes_to_words(np.frombuffer(data, dtype=np.uint8))
+
+
+def words_to_bytes(words: np.ndarray) -> bytes:
+    from .sha256_np import _words_to_bytes
+
+    return _words_to_bytes(np.asarray(words, dtype=np.uint32).reshape(-1)).tobytes()

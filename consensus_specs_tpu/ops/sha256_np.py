@@ -1,0 +1,119 @@
+"""Vectorized sha256 over numpy uint32 lanes.
+
+The Merkleization hot path (hash_tree_root of the beacon state, merkle trees of
+roots) hashes *levels* of independent 64-byte parent nodes — embarrassingly
+parallel. The reference does this one node at a time through hashlib
+(eth2spec/utils/merkle_minimal.py, remerkleable); here a whole level is one
+vectorized compression over N lanes. The JAX twin (ops/sha256_jax.py) runs the
+same schedule on TPU.
+
+All functions operate on big-endian byte semantics (standard sha256).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+], dtype=np.uint32)
+
+_H0 = np.array([
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+], dtype=np.uint32)
+
+
+def _rotr(x: np.ndarray, n: int) -> np.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _schedule(w16: np.ndarray) -> np.ndarray:
+    """Expand 16 message words -> 64. w16: (16, ...) uint32 -> (64, ...)."""
+    w = list(w16)
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w.append((w[t - 16] + s0 + w[t - 7] + s1).astype(np.uint32))
+    return np.stack(w)
+
+
+def _compress(state: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """One compression. state: (8, ...) uint32; w: (64, ...) expanded schedule."""
+    a, b, c, d, e, f, g, h = state
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + _K[t] + w[t]).astype(np.uint32)
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj).astype(np.uint32)
+        h, g, f = g, f, e
+        e = (d + t1).astype(np.uint32)
+        d, c, b = c, b, a
+        a = (t1 + t2).astype(np.uint32)
+    return (state + np.stack([a, b, c, d, e, f, g, h])).astype(np.uint32)
+
+
+# The padding block for a 64-byte message is constant: 0x80, zeros, bitlen=512.
+_PAD64_W16 = np.zeros(16, dtype=np.uint32)
+_PAD64_W16[0] = 0x80000000
+_PAD64_W16[15] = 512
+_PAD64_SCHED = _schedule(_PAD64_W16.reshape(16, 1))[:, 0]  # (64,)
+
+
+def _bytes_to_words(data: np.ndarray) -> np.ndarray:
+    """(..., 4k) uint8 big-endian -> (..., k) uint32."""
+    be = data.reshape(*data.shape[:-1], data.shape[-1] // 4, 4).astype(np.uint32)
+    return (be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3]
+
+
+def _words_to_bytes(words: np.ndarray) -> np.ndarray:
+    """(..., k) uint32 -> (..., 4k) uint8 big-endian."""
+    out = np.empty(words.shape + (4,), dtype=np.uint8)
+    out[..., 0] = words >> 24
+    out[..., 1] = (words >> 16) & 0xFF
+    out[..., 2] = (words >> 8) & 0xFF
+    out[..., 3] = words & 0xFF
+    return out.reshape(*words.shape[:-1], words.shape[-1] * 4)
+
+
+def sha256_64B(data: np.ndarray) -> np.ndarray:
+    """Batched sha256 of N independent 64-byte messages.
+
+    data: (N, 64) uint8 -> (N, 32) uint8. This is the Merkle parent-node hash:
+    data[i] = left_child_root || right_child_root.
+    """
+    n = data.shape[0]
+    w16 = _bytes_to_words(data).T  # (16, N)
+    state = np.repeat(_H0.reshape(8, 1), n, axis=1)
+    state = _compress(state, _schedule(w16))
+    state = _compress(state, np.broadcast_to(_PAD64_SCHED.reshape(64, 1), (64, n)))
+    return _words_to_bytes(state.T)  # (N, 32)
+
+
+def sha256_batch(data: np.ndarray) -> np.ndarray:
+    """Batched sha256 of N equal-length messages. data: (N, L) uint8 -> (N, 32)."""
+    n, length = data.shape
+    padded_len = ((length + 9 + 63) // 64) * 64
+    padded = np.zeros((n, padded_len), dtype=np.uint8)
+    padded[:, :length] = data
+    padded[:, length] = 0x80
+    bitlen = length * 8
+    for i in range(8):
+        padded[:, padded_len - 1 - i] = (bitlen >> (8 * i)) & 0xFF
+    state = np.repeat(_H0.reshape(8, 1), n, axis=1)
+    words = _bytes_to_words(padded)  # (N, padded_len/4)
+    for blk in range(padded_len // 64):
+        w16 = words[:, blk * 16:(blk + 1) * 16].T
+        state = _compress(state, _schedule(w16))
+    return _words_to_bytes(state.T)

@@ -1,0 +1,1 @@
+from .hash import hash_eth2  # noqa: F401

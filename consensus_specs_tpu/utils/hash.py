@@ -1,0 +1,13 @@
+"""Scalar host-side hashing.
+
+Reference parity: eth2spec's ``hash`` helper (tests/core/pyspec/eth2spec/utils/
+hash_function.py:8) — sha256 returning 32 bytes. The batched device/vectorized
+paths live in ops/sha256_np.py and ops/sha256_jax.py; this module is the plain
+one-at-a-time boundary used by host-side control flow.
+"""
+from hashlib import sha256 as _sha256
+
+
+def hash_eth2(data: bytes) -> bytes:
+    """sha256(data) -> 32 bytes."""
+    return _sha256(data).digest()

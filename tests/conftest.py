@@ -1,0 +1,12 @@
+"""Test harness config: force an 8-device virtual CPU mesh before jax loads.
+
+Multi-chip TPU hardware is not available in CI; sharded code paths
+(pjit/shard_map over a Mesh) are validated on 8 virtual CPU devices, mirroring
+how the driver's dryrun_multichip compile-checks the multi-chip path.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,48 @@
+"""Differential tests: batched sha256 kernels vs hashlib."""
+import hashlib
+
+import numpy as np
+
+from consensus_specs_tpu.ops import sha256_np
+
+
+def test_sha256_64B_matches_hashlib():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(17, 64), dtype=np.uint8)
+    out = sha256_np.sha256_64B(data)
+    for i in range(data.shape[0]):
+        assert out[i].tobytes() == hashlib.sha256(data[i].tobytes()).digest()
+
+
+def test_sha256_batch_various_lengths():
+    rng = np.random.default_rng(1)
+    for length in [0, 1, 32, 33, 55, 56, 63, 64, 65, 119, 120, 128, 200]:
+        data = rng.integers(0, 256, size=(5, length), dtype=np.uint8)
+        out = sha256_np.sha256_batch(data)
+        for i in range(5):
+            assert out[i].tobytes() == hashlib.sha256(data[i].tobytes()).digest(), length
+
+
+def test_sha256_jax_matches_hashlib():
+    from consensus_specs_tpu.ops import sha256_jax
+
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(9, 64), dtype=np.uint8)
+    w16 = np.stack([sha256_jax.bytes_to_words(data[i].tobytes()) for i in range(9)])
+    out = np.asarray(sha256_jax.sha256_64B_words(w16))
+    for i in range(9):
+        assert sha256_jax.words_to_bytes(out[i]) == hashlib.sha256(data[i].tobytes()).digest()
+
+
+def test_sha256_jax_1block():
+    from consensus_specs_tpu.ops import sha256_jax
+
+    # 33-byte message (seed || round), padded into one block by hand.
+    msg = bytes(range(33))
+    padded = bytearray(64)
+    padded[:33] = msg
+    padded[33] = 0x80
+    padded[-2:] = (33 * 8).to_bytes(2, "big")
+    w16 = sha256_jax.bytes_to_words(bytes(padded)).reshape(1, 16)
+    out = np.asarray(sha256_jax.sha256_1block(w16))
+    assert sha256_jax.words_to_bytes(out[0]) == hashlib.sha256(msg).digest()
