@@ -1,0 +1,125 @@
+"""Merkleization core.
+
+Reference parity: eth2spec's merkle_minimal (tests/core/pyspec/eth2spec/utils/
+merkle_minimal.py) and the merkleization rules of ssz/simple-serialize.md:210-249
+— but level hashing is *batched*: each tree level is one vectorized sha256 call
+over all parent nodes (ops/sha256_np), instead of a Python loop of hashlib
+calls. Virtual zero-subtree padding keeps huge-limit lists (e.g. the 2^40
+validator registry limit) O(n) instead of O(limit).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ops.sha256_np import sha256_64B
+from ..utils.hash import hash_eth2
+
+ZERO_CHUNK = b"\x00" * 32
+
+# zerohashes[i] = root of a depth-i tree of zero chunks.
+zerohashes: list[bytes] = [ZERO_CHUNK]
+for _ in range(64):
+    zerohashes.append(hash_eth2(zerohashes[-1] + zerohashes[-1]))
+
+# Below this many nodes per level, hashlib beats the numpy kernel's setup cost.
+_NP_BATCH_MIN = 64
+
+
+def next_power_of_two(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def hash_level(level: Sequence[bytes], depth: int) -> list[bytes]:
+    """Hash one level of 32-byte nodes into parents; odd tail is padded with
+    the zero-subtree root for `depth` (the level's height above the leaves)."""
+    n = len(level)
+    if n % 2 == 1:
+        level = list(level) + [zerohashes[depth]]
+        n += 1
+    if n >= _NP_BATCH_MIN:
+        arr = np.frombuffer(b"".join(level), dtype=np.uint8).reshape(n // 2, 64)
+        out = sha256_64B(arr)
+        return [out[i].tobytes() for i in range(n // 2)]
+    return [hash_eth2(level[i] + level[i + 1]) for i in range(0, n, 2)]
+
+
+def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
+    """Merkle root of `chunks`, padded with zero chunks to next_power_of_two
+    (of `limit` if given). ssz/simple-serialize.md merkleize(chunks, limit).
+
+    Raises ValueError if len(chunks) exceeds the limit.
+    """
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise ValueError(f"merkleize: {count} chunks exceeds limit {limit}")
+    target = next_power_of_two(limit)
+    depth = target.bit_length() - 1
+    if count == 0:
+        return zerohashes[depth]
+    level = list(chunks)
+    for d in range(depth):
+        if len(level) == 1:
+            # Remaining ancestors combine with pure zero subtrees.
+            root = level[0]
+            for d2 in range(d, depth):
+                root = hash_eth2(root + zerohashes[d2])
+            return root
+        level = hash_level(level, d)
+    return level[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_eth2(root + length.to_bytes(32, "little"))
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hash_eth2(root + selector.to_bytes(32, "little"))
+
+
+def subtree_root(chunks: Sequence[bytes], height: int, index: int) -> bytes:
+    """Root of the subtree of `height` at position `index` within the
+    virtually zero-padded chunk sequence (leaf span: index*2^h .. (index+1)*2^h)."""
+    start = index << height
+    if start >= len(chunks):
+        return zerohashes[height]
+    if height == 0:
+        return chunks[start]
+    left = subtree_root(chunks, height - 1, 2 * index)
+    right = subtree_root(chunks, height - 1, 2 * index + 1)
+    return hash_eth2(left + right)
+
+
+def calc_merkle_tree_from_leaves(values: Sequence[bytes], layer_count: int = 32) -> list[list[bytes]]:
+    """Full power-of-two padded tree as a list of layers (layer 0 = leaves).
+
+    Reference parity: merkle_minimal.calc_merkle_tree_from_leaves
+    (eth2spec/utils/merkle_minimal.py:12). Materializes 2^layer_count leaf
+    slots *virtually*: each layer stores only the non-zero prefix.
+    """
+    tree: list[list[bytes]] = [list(values)]
+    for d in range(layer_count):
+        level = tree[-1]
+        tree.append(hash_level(level, d) if level else [])
+    return tree
+
+
+def get_merkle_root(tree: list[list[bytes]]) -> bytes:
+    top = tree[-1]
+    return top[0] if top else zerohashes[len(tree) - 1]
+
+
+def get_merkle_proof(tree: list[list[bytes]], item_index: int, tree_len: int | None = None) -> list[bytes]:
+    """Sibling path for leaf `item_index` (reference parity:
+    merkle_minimal.get_merkle_proof, which defaults to len(tree) siblings —
+    one per stored layer including the top). `tree_len` overrides proof depth."""
+    depth = (tree_len if tree_len is not None else len(tree))
+    proof = []
+    for d in range(depth):
+        layer = tree[d]
+        sibling_idx = (item_index >> d) ^ 1
+        proof.append(layer[sibling_idx] if sibling_idx < len(layer) else zerohashes[d])
+    return proof

@@ -1,0 +1,1002 @@
+"""SSZ type zoo: basic and composite SimpleSerialize types.
+
+Built from the SSZ spec rules (reference: ssz/simple-serialize.md — serialization
+:105-187, deserialization :188, merkleization :210-249) as a from-scratch type
+system playing the role remerkleable plays for eth2spec
+(tests/core/pyspec/eth2spec/utils/ssz/ssz_typing.py re-exports). Values are
+plain mutable Python objects; Merkleization batches whole levels through the
+vectorized sha256 kernel (ssz/merkle.py). The device-side struct-of-arrays
+mirror of containers lives in parallel/soa.py, not here.
+
+Type zoo: uintN (8..256), boolean, Container, Vector[T, N], List[T, N],
+Bitvector[N], Bitlist[N], ByteVector[N], ByteList[N], Union[...].
+"""
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+from .merkle import merkleize_chunks, mix_in_length, mix_in_selector
+
+BYTES_PER_CHUNK = 32
+OFFSET_BYTE_LENGTH = 4
+
+
+class SSZType:
+    """Mixin namespace for class-level SSZ protocol methods.
+
+    Concrete types implement:
+      is_fixed_size() -> bool
+      type_byte_length() -> int            (fixed-size types only)
+      min_byte_length() / max_byte_length()
+      default() -> value
+      coerce(v) -> value
+      decode_bytes(data: bytes) -> value   (validating deserialization)
+    Instances implement:
+      encode_bytes() -> bytes
+      hash_tree_root() -> bytes (32)
+    """
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        raise NotImplementedError
+
+    @classmethod
+    def default(cls):
+        raise NotImplementedError
+
+    @classmethod
+    def coerce(cls, v):
+        raise NotImplementedError
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        raise NotImplementedError
+
+
+def _pack_bytes_to_chunks(data: bytes) -> list[bytes]:
+    """Right-pad to a chunk multiple and split (spec `pack`)."""
+    if len(data) % BYTES_PER_CHUNK != 0:
+        data = data + b"\x00" * (BYTES_PER_CHUNK - len(data) % BYTES_PER_CHUNK)
+    return [data[i:i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)] or []
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+class uint(int, SSZType):
+    BYTE_LEN: int = 0  # overridden
+
+    def __new__(cls, value: int = 0):
+        value = int(value)
+        if not 0 <= value < (1 << (cls.BYTE_LEN * 8)):
+            raise ValueError(f"{cls.__name__} out of range: {value}")
+        return super().__new__(cls, value)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.BYTE_LEN
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, bool):
+            raise TypeError(f"cannot coerce bool to {cls.__name__}")
+        if isinstance(v, int):
+            return cls(v)
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.BYTE_LEN:
+            raise ValueError(f"{cls.__name__}: expected {cls.BYTE_LEN} bytes, got {len(data)}")
+        return cls(int.from_bytes(data, "little"))
+
+    def encode_bytes(self) -> bytes:
+        return int(self).to_bytes(self.BYTE_LEN, "little")
+
+    def hash_tree_root(self) -> bytes:
+        return int(self).to_bytes(self.BYTE_LEN, "little") + b"\x00" * (32 - self.BYTE_LEN)
+
+    def copy(self):
+        return self
+
+    # Range-checked arithmetic closed over the operand's type (mirrors
+    # remerkleable semantics the executable spec relies on: Slot + 1 is a
+    # Slot; overflow/underflow raises instead of silently wrapping).
+    def _wrap(self, value: int):
+        return type(self)(value)
+
+    def __add__(self, o): return self._wrap(int(self) + int(o))
+    def __radd__(self, o): return self._wrap(int(o) + int(self))
+    def __sub__(self, o): return self._wrap(int(self) - int(o))
+    def __rsub__(self, o): return self._wrap(int(o) - int(self))
+    def __mul__(self, o): return self._wrap(int(self) * int(o))
+    def __rmul__(self, o): return self._wrap(int(o) * int(self))
+    def __floordiv__(self, o): return self._wrap(int(self) // int(o))
+    def __rfloordiv__(self, o): return self._wrap(int(o) // int(self))
+    def __mod__(self, o): return self._wrap(int(self) % int(o))
+    def __rmod__(self, o): return self._wrap(int(o) % int(self))
+    def __pow__(self, o, mod=None): return self._wrap(pow(int(self), int(o), mod))
+    def __lshift__(self, o): return self._wrap(int(self) << int(o))
+    def __rshift__(self, o): return self._wrap(int(self) >> int(o))
+    def __and__(self, o): return self._wrap(int(self) & int(o))
+    def __or__(self, o): return self._wrap(int(self) | int(o))
+    def __xor__(self, o): return self._wrap(int(self) ^ int(o))
+    def __invert__(self): return self._wrap((1 << (self.BYTE_LEN * 8)) - 1 - int(self))
+
+
+class uint8(uint):
+    BYTE_LEN = 1
+
+
+class uint16(uint):
+    BYTE_LEN = 2
+
+
+class uint32(uint):
+    BYTE_LEN = 4
+
+
+class uint64(uint):
+    BYTE_LEN = 8
+
+
+class uint128(uint):
+    BYTE_LEN = 16
+
+
+class uint256(uint):
+    BYTE_LEN = 32
+
+
+byte = uint8
+
+
+class boolean(int, SSZType):
+    def __new__(cls, value=False):
+        value = int(value)
+        if value not in (0, 1):
+            raise ValueError(f"boolean must be 0 or 1, got {value}")
+        return super().__new__(cls, value)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return 1
+
+    @classmethod
+    def default(cls):
+        return cls(False)
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, (bool, int)):
+            return cls(v)
+        raise TypeError(f"cannot coerce {type(v).__name__} to boolean")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != 1:
+            raise ValueError("boolean: expected 1 byte")
+        if data[0] not in (0, 1):
+            raise ValueError(f"boolean: invalid byte {data[0]}")
+        return cls(data[0])
+
+    def encode_bytes(self) -> bytes:
+        return bytes([int(self)])
+
+    def hash_tree_root(self) -> bytes:
+        return bytes([int(self)]) + b"\x00" * 31
+
+    def copy(self):
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Parameterized type machinery
+# ---------------------------------------------------------------------------
+
+def _type_name(t: Any) -> str:
+    return t.__name__ if hasattr(t, "__name__") else str(t)
+
+
+class _ParamMeta(type):
+    """Metaclass giving generic SSZ types a cached `Base[params]` syntax so
+    `List[uint64, 8] is List[uint64, 8]` and isinstance checks work."""
+    _cache: dict = {}
+
+    def __getitem__(cls, params):
+        if not isinstance(params, tuple):
+            params = (params,)
+        key = (cls, params)
+        cached = _ParamMeta._cache.get(key)
+        if cached is not None:
+            return cached
+        sub = cls._parameterize(params)
+        _ParamMeta._cache[key] = sub
+        return sub
+
+
+# ---------------------------------------------------------------------------
+# Byte types
+# ---------------------------------------------------------------------------
+
+class ByteVector(bytes, SSZType, metaclass=_ParamMeta):
+    LENGTH: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        (length,) = params
+        return type(f"ByteVector[{length}]", (ByteVector,), {"LENGTH": int(length)})
+
+    def __new__(cls, data: bytes | None = None):
+        if cls.LENGTH == 0 and cls is ByteVector:
+            raise TypeError("use ByteVector[N]")
+        if data is None:
+            data = b"\x00" * cls.LENGTH
+        if isinstance(data, str):
+            data = bytes.fromhex(data[2:] if data.startswith("0x") else data)
+        data = bytes(data)
+        if len(data) != cls.LENGTH:
+            raise ValueError(f"{cls.__name__}: expected {cls.LENGTH} bytes, got {len(data)}")
+        return super().__new__(cls, data)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.LENGTH
+
+    @classmethod
+    def default(cls):
+        return cls(b"\x00" * cls.LENGTH)
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, (bytes, bytearray, str)):
+            return cls(v)
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(_pack_bytes_to_chunks(bytes(self)))
+
+    def copy(self):
+        return self
+
+
+Bytes1 = ByteVector[1]
+Bytes4 = ByteVector[4]
+Bytes8 = ByteVector[8]
+Bytes20 = ByteVector[20]
+Bytes32 = ByteVector[32]
+Bytes48 = ByteVector[48]
+Bytes96 = ByteVector[96]
+
+
+class ByteList(bytes, SSZType, metaclass=_ParamMeta):
+    LIMIT: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        (limit,) = params
+        return type(f"ByteList[{limit}]", (ByteList,), {"LIMIT": int(limit)})
+
+    def __new__(cls, data: bytes = b""):
+        data = bytes(data)
+        if len(data) > cls.LIMIT:
+            raise ValueError(f"{cls.__name__}: {len(data)} bytes exceeds limit {cls.LIMIT}")
+        return super().__new__(cls, data)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls(b"")
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, (bytes, bytearray)):
+            return cls(v)
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        limit_chunks = (self.LIMIT + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        root = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=limit_chunks)
+        return mix_in_length(root, len(self))
+
+    def copy(self):
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Bit types
+# ---------------------------------------------------------------------------
+
+def _bits_from_args(args) -> list[bool]:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)) :
+        args = args[0]
+    return [bool(b) for b in args]
+
+
+def _bits_to_bytes(bits: Sequence[bool]) -> bytes:
+    out = bytearray((len(bits) + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+class Bitvector(SSZType, metaclass=_ParamMeta):
+    LENGTH: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        (length,) = params
+        if int(length) <= 0:
+            raise TypeError("Bitvector length must be > 0")
+        return type(f"Bitvector[{length}]", (Bitvector,), {"LENGTH": int(length)})
+
+    def __init__(self, *args):
+        bits = _bits_from_args(args)
+        if len(bits) == 0:
+            bits = [False] * self.LENGTH
+        if len(bits) != self.LENGTH:
+            raise ValueError(f"{type(self).__name__}: expected {self.LENGTH} bits, got {len(bits)}")
+        self._bits = bits
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return (cls.LENGTH + 7) // 8
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, (list, tuple)):
+            return cls(v)
+        if isinstance(v, Bitvector) and type(v).LENGTH == cls.LENGTH:
+            return cls(v._bits)
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.type_byte_length():
+            raise ValueError(f"{cls.__name__}: wrong byte length {len(data)}")
+        bits = [(data[i // 8] >> (i % 8)) & 1 == 1 for i in range(cls.LENGTH)]
+        # Excess high bits must be zero.
+        for i in range(cls.LENGTH, len(data) * 8):
+            if (data[i // 8] >> (i % 8)) & 1:
+                raise ValueError(f"{cls.__name__}: non-zero padding bit {i}")
+        return cls(bits)
+
+    def encode_bytes(self) -> bytes:
+        out = bytearray(self.type_byte_length())
+        for i, b in enumerate(self._bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(_pack_bytes_to_chunks(self.encode_bytes()))
+
+    def copy(self):
+        return type(self)(list(self._bits))
+
+    def __len__(self):
+        return self.LENGTH
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._bits == other._bits
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(self._bits)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({''.join('1' if b else '0' for b in self._bits)})"
+
+
+class Bitlist(SSZType, metaclass=_ParamMeta):
+    LIMIT: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        (limit,) = params
+        return type(f"Bitlist[{limit}]", (Bitlist,), {"LIMIT": int(limit)})
+
+    def __init__(self, *args):
+        bits = _bits_from_args(args)
+        if len(bits) > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: {len(bits)} bits exceeds limit {self.LIMIT}")
+        self._bits = bits
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, (list, tuple)):
+            return cls(v)
+        if isinstance(v, Bitlist) and type(v).LIMIT == cls.LIMIT:
+            return cls(v._bits)
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError("Bitlist: empty serialization")
+        if data[-1] == 0:
+            raise ValueError("Bitlist: no delimiter bit")
+        total_bits = len(data) * 8
+        # Position of the delimiter = highest set bit.
+        last = data[-1]
+        delim = (len(data) - 1) * 8 + last.bit_length() - 1
+        if delim > cls.LIMIT:
+            raise ValueError(f"Bitlist: length {delim} exceeds limit {cls.LIMIT}")
+        bits = [(data[i // 8] >> (i % 8)) & 1 == 1 for i in range(delim)]
+        for i in range(delim + 1, total_bits):
+            if (data[i // 8] >> (i % 8)) & 1:
+                raise ValueError(f"Bitlist: non-zero bit {i} past delimiter")
+        return cls(bits)
+
+    def encode_bytes(self) -> bytes:
+        bits = list(self._bits) + [True]  # delimiter
+        return _bits_to_bytes(bits)
+
+    def hash_tree_root(self) -> bytes:
+        limit_chunks = (self.LIMIT + 255) // 256
+        chunks = _pack_bytes_to_chunks(_bits_to_bytes(self._bits)) if self._bits else []
+        root = merkleize_chunks(chunks, limit=limit_chunks)
+        return mix_in_length(root, len(self._bits))
+
+    def copy(self):
+        return type(self)(list(self._bits))
+
+    def append(self, v):
+        if len(self._bits) >= self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: append past limit")
+        self._bits.append(bool(v))
+
+    def __len__(self):
+        return len(self._bits)
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._bits == other._bits
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(self._bits)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({''.join('1' if b else '0' for b in self._bits)})"
+
+
+# ---------------------------------------------------------------------------
+# Sequence composites
+# ---------------------------------------------------------------------------
+
+def _is_basic(t) -> bool:
+    return isinstance(t, type) and issubclass(t, (uint, boolean))
+
+
+def _elems_from_args(args) -> list:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)) and not isinstance(args[0], (bytes, str)):
+        return list(args[0])
+    if len(args) == 1 and hasattr(args[0], "__iter__") and not isinstance(args[0], (bytes, str, int)):
+        return list(args[0])
+    return list(args)
+
+
+class _Sequence(SSZType):
+    ELEM_TYPE: type
+
+    def _coerce_elems(self, elems):
+        return [self.ELEM_TYPE.coerce(e) if not isinstance(e, self.ELEM_TYPE) else e for e in elems]
+
+    def __len__(self):
+        return len(self._elems)
+
+    def __iter__(self):
+        return iter(self._elems)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._elems[i]
+        return self._elems[i]
+
+    def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            new = list(self._elems)
+            new[i] = self._coerce_elems(v)
+            self._check_length(len(new))
+            self._elems = new
+        else:
+            self._elems[i] = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
+
+    def _check_length(self, n: int) -> None:
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._elems == other._elems
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(self._elems)))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._elems!r})"
+
+    def index(self, v):
+        return self._elems.index(v)
+
+    def __contains__(self, v):
+        return v in self._elems
+
+    # --- shared serialization over self._elems ---
+
+    def encode_bytes(self) -> bytes:
+        et = self.ELEM_TYPE
+        if et.is_fixed_size():
+            return b"".join(e.encode_bytes() for e in self._elems)
+        parts = [e.encode_bytes() for e in self._elems]
+        offset = OFFSET_BYTE_LENGTH * len(parts)
+        out = bytearray()
+        for p in parts:
+            out += offset.to_bytes(OFFSET_BYTE_LENGTH, "little")
+            offset += len(p)
+        for p in parts:
+            out += p
+        return bytes(out)
+
+    @classmethod
+    def _decode_elems(cls, data: bytes) -> list:
+        et = cls.ELEM_TYPE
+        if et.is_fixed_size():
+            size = et.type_byte_length()
+            if len(data) % size != 0:
+                raise ValueError(f"{cls.__name__}: byte length {len(data)} not a multiple of {size}")
+            return [et.decode_bytes(data[i:i + size]) for i in range(0, len(data), size)]
+        if len(data) == 0:
+            return []
+        first_offset = int.from_bytes(data[:OFFSET_BYTE_LENGTH], "little")
+        if first_offset % OFFSET_BYTE_LENGTH != 0 or first_offset == 0:
+            raise ValueError(f"{cls.__name__}: invalid first offset {first_offset}")
+        count = first_offset // OFFSET_BYTE_LENGTH
+        offsets = [int.from_bytes(data[i * 4:(i + 1) * 4], "little") for i in range(count)]
+        offsets.append(len(data))
+        elems = []
+        for i in range(count):
+            if offsets[i] > offsets[i + 1] or offsets[i] > len(data):
+                raise ValueError(f"{cls.__name__}: offsets not monotonic")
+            elems.append(et.decode_bytes(data[offsets[i]:offsets[i + 1]]))
+        return elems
+
+    def _chunks(self) -> list[bytes]:
+        et = self.ELEM_TYPE
+        if _is_basic(et):
+            return _pack_bytes_to_chunks(b"".join(e.encode_bytes() for e in self._elems))
+        return [e.hash_tree_root() for e in self._elems]
+
+
+class Vector(_Sequence, metaclass=_ParamMeta):
+    LENGTH: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        elem_type, length = params
+        if int(length) <= 0:
+            raise TypeError("Vector length must be > 0")
+        return type(
+            f"Vector[{_type_name(elem_type)},{length}]", (Vector,),
+            {"ELEM_TYPE": elem_type, "LENGTH": int(length)},
+        )
+
+    def __init__(self, *args):
+        elems = _elems_from_args(args)
+        if len(elems) == 0:
+            elems = [self.ELEM_TYPE.default() for _ in range(self.LENGTH)]
+        if len(elems) != self.LENGTH:
+            raise ValueError(f"{type(self).__name__}: expected {self.LENGTH} elements, got {len(elems)}")
+        self._elems = self._coerce_elems(elems)
+
+    def _check_length(self, n: int) -> None:
+        if n != self.LENGTH:
+            raise ValueError(f"{type(self).__name__}: mutation would change length to {n}")
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return cls.ELEM_TYPE.is_fixed_size()
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.ELEM_TYPE.type_byte_length() * cls.LENGTH
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, (list, tuple)) or (isinstance(v, _Sequence)):
+            return cls(list(v))
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        elems = cls._decode_elems(data)
+        if len(elems) != cls.LENGTH:
+            raise ValueError(f"{cls.__name__}: decoded {len(elems)} elements, expected {cls.LENGTH}")
+        return cls(elems)
+
+    def hash_tree_root(self) -> bytes:
+        return merkleize_chunks(self._chunks())
+
+    def copy(self):
+        return type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+
+
+class List(_Sequence, metaclass=_ParamMeta):
+    LIMIT: int = 0
+
+    @classmethod
+    def _parameterize(cls, params):
+        elem_type, limit = params
+        return type(
+            f"List[{_type_name(elem_type)},{limit}]", (List,),
+            {"ELEM_TYPE": elem_type, "LIMIT": int(limit)},
+        )
+
+    def __init__(self, *args):
+        elems = _elems_from_args(args)
+        if len(elems) > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: {len(elems)} elements exceeds limit {self.LIMIT}")
+        self._elems = self._coerce_elems(elems)
+
+    def _check_length(self, n: int) -> None:
+        if n > self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: mutation would exceed limit ({n} > {self.LIMIT})")
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, (list, tuple)) or isinstance(v, _Sequence):
+            return cls(list(v))
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        elems = cls._decode_elems(data)
+        if len(elems) > cls.LIMIT:
+            raise ValueError(f"{cls.__name__}: {len(elems)} elements exceeds limit")
+        return cls(elems)
+
+    @classmethod
+    def chunk_limit(cls) -> int:
+        if _is_basic(cls.ELEM_TYPE):
+            return (cls.LIMIT * cls.ELEM_TYPE.type_byte_length() + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return cls.LIMIT
+
+    def hash_tree_root(self) -> bytes:
+        root = merkleize_chunks(self._chunks(), limit=self.chunk_limit())
+        return mix_in_length(root, len(self._elems))
+
+    def copy(self):
+        return type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+
+    def append(self, v):
+        if len(self._elems) >= self.LIMIT:
+            raise ValueError(f"{type(self).__name__}: append past limit")
+        self._elems.append(v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v))
+
+    def pop(self):
+        if not self._elems:
+            raise IndexError("pop from empty List")
+        return self._elems.pop()
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+class Container(SSZType):
+    """SSZ container; fields declared as class annotations:
+
+        class Checkpoint(Container):
+            epoch: uint64
+            root: Bytes32
+    """
+    _fields_cache: dict | None = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        cls._fields_cache = None
+
+    @classmethod
+    def fields(cls) -> dict:
+        if cls.__dict__.get("_fields_cache") is None:
+            fields: dict = {}
+            for klass in reversed(cls.__mro__):
+                ann = klass.__dict__.get("__annotations__", {})
+                for name, typ in ann.items():
+                    if name.startswith("_"):
+                        continue
+                    fields[name] = typ
+            cls._fields_cache = fields
+        return cls._fields_cache
+
+    def __init__(self, **kwargs):
+        fields = self.fields()
+        for name in kwargs:
+            if name not in fields:
+                raise TypeError(f"{type(self).__name__}: unknown field {name}")
+        for name, typ in fields.items():
+            if name in kwargs:
+                v = kwargs[name]
+                value = v if isinstance(v, typ) else typ.coerce(v)
+            else:
+                value = typ.default()
+            object.__setattr__(self, name, value)
+
+    def __setattr__(self, name, value):
+        fields = self.fields()
+        if name in fields:
+            typ = fields[name]
+            if not isinstance(value, typ):
+                value = typ.coerce(value)
+        object.__setattr__(self, name, value)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return all(t.is_fixed_size() for t in cls.fields().values())
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        if not cls.is_fixed_size():
+            raise TypeError(f"{cls.__name__} is variable-size")
+        return sum(t.type_byte_length() for t in cls.fields().values())
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        if isinstance(v, Container) and type(v).fields() == cls.fields():
+            return cls(**{n: getattr(v, n) for n in cls.fields()})
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    def encode_bytes(self) -> bytes:
+        fields = self.fields()
+        fixed_parts: list[bytes | None] = []
+        variable_parts: list[bytes] = []
+        for name, typ in fields.items():
+            v = getattr(self, name)
+            if typ.is_fixed_size():
+                fixed_parts.append(v.encode_bytes())
+            else:
+                fixed_parts.append(None)
+                variable_parts.append(v.encode_bytes())
+        fixed_len = sum(len(p) if p is not None else OFFSET_BYTE_LENGTH for p in fixed_parts)
+        out = bytearray()
+        offset = fixed_len
+        var_iter = iter(variable_parts)
+        for p in fixed_parts:
+            if p is None:
+                out += offset.to_bytes(OFFSET_BYTE_LENGTH, "little")
+                offset += len(next(var_iter))
+            else:
+                out += p
+        for p in variable_parts:
+            out += p
+        return bytes(out)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        fields = cls.fields()
+        fixed_len = sum(
+            t.type_byte_length() if t.is_fixed_size() else OFFSET_BYTE_LENGTH
+            for t in fields.values()
+        )
+        if len(data) < fixed_len:
+            raise ValueError(f"{cls.__name__}: {len(data)} bytes < fixed part {fixed_len}")
+        pos = 0
+        offsets: list[tuple[str, int]] = []
+        values: dict = {}
+        for name, typ in fields.items():
+            if typ.is_fixed_size():
+                size = typ.type_byte_length()
+                values[name] = typ.decode_bytes(data[pos:pos + size])
+                pos += size
+            else:
+                off = int.from_bytes(data[pos:pos + OFFSET_BYTE_LENGTH], "little")
+                offsets.append((name, off))
+                pos += OFFSET_BYTE_LENGTH
+        if offsets:
+            if offsets[0][1] != fixed_len:
+                raise ValueError(f"{cls.__name__}: first offset {offsets[0][1]} != fixed length {fixed_len}")
+            bounds = [off for _, off in offsets] + [len(data)]
+            for i, (name, off) in enumerate(offsets):
+                if bounds[i] > bounds[i + 1]:
+                    raise ValueError(f"{cls.__name__}: offsets not monotonic")
+                values[name] = fields[name].decode_bytes(data[bounds[i]:bounds[i + 1]])
+        elif pos != len(data):
+            raise ValueError(f"{cls.__name__}: {len(data) - pos} trailing bytes")
+        return cls(**values)
+
+    def hash_tree_root(self) -> bytes:
+        chunks = [getattr(self, name).hash_tree_root() for name in self.fields()]
+        return merkleize_chunks(chunks)
+
+    def copy(self):
+        return type(self)(**{
+            name: (v.copy() if hasattr(v, "copy") else v)
+            for name in self.fields()
+            for v in [getattr(self, name)]
+        })
+
+    def __eq__(self, other):
+        return type(self) is type(other) and all(
+            getattr(self, n) == getattr(other, n) for n in self.fields()
+        )
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={getattr(self, n)!r}" for n in self.fields())
+        return f"{type(self).__name__}({inner})"
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+
+class Union(SSZType, metaclass=_ParamMeta):
+    OPTIONS: tuple = ()
+
+    @classmethod
+    def _parameterize(cls, params):
+        names = ",".join("None" if p is type(None) or p is None else _type_name(p) for p in params)
+        opts = tuple(None if p is type(None) else p for p in params)
+        if opts and opts[0] is None and len(opts) == 1:
+            raise TypeError("Union[None] alone is invalid")
+        return type(f"Union[{names}]", (Union,), {"OPTIONS": opts})
+
+    def __init__(self, selector: int, value=None):
+        opts = self.OPTIONS
+        if not 0 <= selector < len(opts):
+            raise ValueError(f"Union selector {selector} out of range")
+        typ = opts[selector]
+        if typ is None:
+            if value is not None:
+                raise ValueError("Union: selector 0 (None) must have no value")
+        else:
+            value = value if isinstance(value, typ) else typ.coerce(value)
+        self.selector = selector
+        self.value = value
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return False
+
+    @classmethod
+    def default(cls):
+        typ = cls.OPTIONS[0]
+        return cls(0, None if typ is None else typ.default())
+
+    @classmethod
+    def coerce(cls, v):
+        if isinstance(v, cls):
+            return v
+        raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError("Union: empty serialization")
+        selector = data[0]
+        if selector >= len(cls.OPTIONS):
+            raise ValueError(f"Union: invalid selector {selector}")
+        typ = cls.OPTIONS[selector]
+        if typ is None:
+            if len(data) != 1:
+                raise ValueError("Union: trailing bytes after None selector")
+            return cls(0, None)
+        return cls(selector, typ.decode_bytes(data[1:]))
+
+    def encode_bytes(self) -> bytes:
+        body = b"" if self.value is None else self.value.encode_bytes()
+        return bytes([self.selector]) + body
+
+    def hash_tree_root(self) -> bytes:
+        root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
+        return mix_in_selector(root, self.selector)
+
+    def copy(self):
+        v = self.value
+        return type(self)(self.selector, v.copy() if hasattr(v, "copy") else v)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.selector == other.selector and self.value == other.value
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.selector, self.value))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(selector={self.selector}, value={self.value!r})"

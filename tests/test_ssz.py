@@ -1,0 +1,353 @@
+"""SSZ engine tests: serialization, merkleization, proofs, gindices.
+
+Expected values are hand-derived from the SSZ spec rules (ssz/simple-serialize.md)
+with explicit hashlib trees — independent of the implementation under test.
+"""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu.ssz import (
+    Bitlist, Bitvector, ByteList, Bytes32, Bytes48, Container, List, Union,
+    Vector, boolean, build_proof, deserialize, get_generalized_index,
+    get_generalized_index_length, hash_tree_root, is_valid_merkle_branch,
+    merkleize_chunks, serialize, uint8, uint16, uint64, uint256, zerohashes,
+)
+from consensus_specs_tpu.ssz.proofs import get_subtree_node_root
+
+
+def H(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def chunk(data: bytes) -> bytes:
+    return data + b"\x00" * (32 - len(data))
+
+
+# --- basic types ---
+
+def test_uint_serialization():
+    assert serialize(uint64(0x0102030405060708)) == bytes.fromhex("0807060504030201")
+    assert serialize(uint8(5)) == b"\x05"
+    assert serialize(uint16(0xABCD)) == b"\xcd\xab"
+    assert deserialize(uint64, bytes(8)) == 0
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+
+
+def test_uint_arithmetic_semantics():
+    class Slot(uint64):
+        pass
+
+    s = Slot(5)
+    assert type(s + 1) is Slot and s + 1 == 6
+    with pytest.raises(ValueError):
+        s - 6  # underflow raises, never wraps
+    with pytest.raises(ValueError):
+        uint64(2**64 - 1) + 1
+    assert uint64(7) % 3 == 1
+    assert uint64(1) << 10 == 1024
+
+
+def test_boolean():
+    assert serialize(boolean(True)) == b"\x01"
+    with pytest.raises(ValueError):
+        deserialize(boolean, b"\x02")
+
+
+def test_uint_htr():
+    assert hash_tree_root(uint64(1)) == chunk(bytes.fromhex("0100000000000000"))
+    assert hash_tree_root(uint256(1)) == (1).to_bytes(32, "little")
+
+
+# --- merkleize ---
+
+def test_merkleize_manual():
+    c1, c2, c3 = chunk(b"\x01"), chunk(b"\x02"), chunk(b"\x03")
+    assert merkleize_chunks([]) == zerohashes[0]
+    assert merkleize_chunks([c1]) == c1
+    assert merkleize_chunks([c1, c2]) == H(c1, c2)
+    assert merkleize_chunks([c1, c2, c3]) == H(H(c1, c2), H(c3, zerohashes[0]))
+    # limit padding: 2 chunks with limit 4 -> depth 2
+    assert merkleize_chunks([c1, c2], limit=4) == H(H(c1, c2), zerohashes[1])
+    # virtual deep padding: 1 chunk, limit 2**10
+    expect = c1
+    for d in range(10):
+        expect = H(expect, zerohashes[d])
+    assert merkleize_chunks([c1], limit=2**10) == expect
+    with pytest.raises(ValueError):
+        merkleize_chunks([c1, c2, c3], limit=2)
+
+
+# --- vectors/lists ---
+
+def test_vector_basic():
+    V = Vector[uint64, 4]
+    v = V(1, 2, 3, 4)
+    assert serialize(v) == b"".join(i.to_bytes(8, "little") for i in (1, 2, 3, 4))
+    assert hash_tree_root(v) == chunk(serialize(v))
+    assert deserialize(V, serialize(v)) == v
+    assert V() == V(0, 0, 0, 0)
+    with pytest.raises(ValueError):
+        V(1, 2, 3)
+
+
+def test_list_basic_htr():
+    L = List[uint64, 8]  # chunk limit = ceil(8*8/32) = 2
+    l = L(1, 2, 3)
+    data = b"".join(i.to_bytes(8, "little") for i in (1, 2, 3))
+    assert serialize(l) == data
+    c0 = chunk(data[:32])
+    c1 = chunk(data[32:])
+    expect = H(H(c0, c1), (3).to_bytes(32, "little"))
+    assert hash_tree_root(l) == expect
+    assert deserialize(L, data) == l
+    l2 = l.copy()
+    l2.append(9)
+    assert len(l) == 3 and len(l2) == 4
+
+
+def test_list_huge_limit():
+    L = List[uint64, 2**40]
+    l = L(5)
+    root = hash_tree_root(l)  # must not materialize 2^40 chunks
+    # depth = log2(2^40 * 8 / 32) = 38
+    expect = chunk((5).to_bytes(8, "little"))
+    for d in range(38):
+        expect = H(expect, zerohashes[d])
+    assert root == H(expect, (1).to_bytes(32, "little"))
+
+
+def test_empty_list_htr():
+    L = List[uint64, 4]
+    assert hash_tree_root(L()) == H(zerohashes[0], (0).to_bytes(32, "little"))
+
+
+def test_list_of_containers():
+    class Point(Container):
+        x: uint64
+        y: uint64
+
+    L = List[Point, 4]
+    l = L(Point(x=1, y=2), Point(x=3, y=4))
+    pr = [hash_tree_root(p) for p in l]
+    expect = H(H(H(pr[0], pr[1]), zerohashes[1]), (2).to_bytes(32, "little"))
+    assert hash_tree_root(l) == expect
+    assert deserialize(L, serialize(l)) == l
+
+
+# --- bits ---
+
+def test_bitvector():
+    B = Bitvector[10]
+    b = B([1, 0, 1, 0, 0, 0, 0, 0, 1, 1])
+    # bits little-endian within bytes: 0b00000101 = 0x05, 0b00000011 = 0x03
+    assert serialize(b) == bytes([0x05, 0x03])
+    assert hash_tree_root(b) == chunk(bytes([0x05, 0x03]))
+    assert deserialize(B, serialize(b)) == b
+    with pytest.raises(ValueError):
+        deserialize(B, bytes([0x05, 0x07]))  # padding bit set (bit 10)
+
+
+def test_bitlist():
+    B = Bitlist[8]
+    b = B(1, 0, 1)
+    assert serialize(b) == bytes([0b1101])  # bits + delimiter at index 3
+    assert deserialize(B, serialize(b)) == b
+    assert hash_tree_root(b) == H(chunk(bytes([0b101])), (3).to_bytes(32, "little"))
+    assert serialize(Bitlist[8]()) == b"\x01"
+    with pytest.raises(ValueError):
+        deserialize(B, b"\x00")  # no delimiter
+    with pytest.raises(ValueError):
+        deserialize(Bitlist[2], bytes([0b1111]))  # length 3 > limit 2
+
+
+# --- containers ---
+
+class Fixed(Container):
+    a: uint64
+    b: Bytes32
+
+
+class WithVar(Container):
+    a: uint16
+    b: List[uint8, 10]
+    c: uint16
+
+
+def test_container_fixed():
+    f = Fixed(a=7, b=Bytes32(b"\x11" * 32))
+    assert serialize(f) == (7).to_bytes(8, "little") + b"\x11" * 32
+    assert hash_tree_root(f) == H(chunk((7).to_bytes(8, "little")), b"\x11" * 32)
+    assert deserialize(Fixed, serialize(f)) == f
+    assert Fixed().a == 0 and Fixed().b == Bytes32()
+
+
+def test_container_variable_offsets():
+    w = WithVar(a=1, b=[3, 4, 5], c=2)
+    # fixed part: a(2) + offset(4) + c(2) = 8; b's payload at offset 8
+    expect = (1).to_bytes(2, "little") + (8).to_bytes(4, "little") + (2).to_bytes(2, "little") + bytes([3, 4, 5])
+    assert serialize(w) == expect
+    assert deserialize(WithVar, expect) == w
+    # bad first offset
+    bad = (1).to_bytes(2, "little") + (9).to_bytes(4, "little") + (2).to_bytes(2, "little") + bytes([3, 4, 5])
+    with pytest.raises(ValueError):
+        deserialize(WithVar, bad)
+
+
+def test_container_field_assignment_coercion():
+    f = Fixed()
+    f.a = 9
+    assert type(f.a) is uint64
+    with pytest.raises(ValueError):
+        f.a = -1
+    with pytest.raises(TypeError):
+        WithVar(nope=1)
+
+
+def test_container_copy_independent():
+    w = WithVar(a=1, b=[3], c=2)
+    w2 = w.copy()
+    w2.b.append(7)
+    w2.a = 5
+    assert len(w.b) == 1 and w.a == 1
+    assert len(w2.b) == 2 and w2.a == 5
+
+
+def test_bytelist():
+    BL = ByteList[5]
+    assert serialize(BL(b"ab")) == b"ab"
+    assert hash_tree_root(BL(b"ab")) == H(chunk(b"ab"), (2).to_bytes(32, "little"))
+    with pytest.raises(ValueError):
+        BL(b"abcdef")
+
+
+# --- union ---
+
+def test_union():
+    U = Union[None, uint64, Bytes32]
+    u0 = U(0)
+    assert serialize(u0) == b"\x00"
+    assert hash_tree_root(u0) == H(b"\x00" * 32, (0).to_bytes(32, "little"))
+    u1 = U(1, 7)
+    assert serialize(u1) == b"\x01" + (7).to_bytes(8, "little")
+    assert hash_tree_root(u1) == H(chunk((7).to_bytes(8, "little")), (1).to_bytes(32, "little"))
+    assert deserialize(U, serialize(u1)) == u1
+    with pytest.raises(ValueError):
+        deserialize(U, b"\x05")
+
+
+# --- gindex + proofs ---
+
+def test_gindex_container():
+    # Fixed has 2 fields -> depth 1: a at 2, b at 3
+    assert get_generalized_index(Fixed, "a") == 2
+    assert get_generalized_index(Fixed, "b") == 3
+    # List[uint64, 8]: mix_in_length (x2), chunk limit 2 (depth 1): elem 3 in chunk 0
+    assert get_generalized_index(List[uint64, 8], 0) == 4
+    assert get_generalized_index(List[uint64, 8], 5) == 5
+    assert get_generalized_index(List[uint64, 8], "__len__") == 3
+
+
+def test_gindex_nested():
+    class Outer(Container):
+        x: uint64
+        inner: Fixed
+        l: List[uint64, 8]
+        pad: uint64
+
+    # 4 fields, depth 2: x=4, inner=5, l=6, pad=7
+    assert get_generalized_index(Outer, "x") == 4
+    assert get_generalized_index(Outer, "inner", "b") == 5 * 2 + 1
+    assert get_generalized_index(Outer, "l", 0) == 6 * 2 * 2
+
+
+def test_build_proof_roundtrip():
+    class Outer(Container):
+        x: uint64
+        inner: Fixed
+        l: List[uint64, 2**10]
+        pad: uint64
+
+    obj = Outer(x=1, inner=Fixed(a=2, b=Bytes32(b"\x22" * 32)), l=[5, 6, 7], pad=9)
+    root = hash_tree_root(obj)
+    for path in [("x",), ("inner", "a"), ("inner", "b"), ("pad",), ("l", 0), ("l", 200), ("l", "__len__")]:
+        gi = get_generalized_index(Outer, *path)
+        proof = build_proof(obj, gi)
+        leaf = get_subtree_node_root(obj, gi)
+        depth = get_generalized_index_length(gi)
+        index = gi - (1 << depth)
+        assert is_valid_merkle_branch(leaf, proof, depth, index, root), path
+        # wrong leaf must fail
+        assert not is_valid_merkle_branch(b"\x55" * 32, proof, depth, index, root)
+
+
+def test_proof_leaf_values():
+    obj = Fixed(a=77, b=Bytes32(b"\x33" * 32))
+    assert get_subtree_node_root(obj, 2) == chunk((77).to_bytes(8, "little"))
+    assert get_subtree_node_root(obj, 3) == b"\x33" * 32
+
+
+def test_type_identity_cache():
+    assert List[uint64, 8] is List[uint64, 8]
+    assert Vector[uint8, 3] is Vector[uint8, 3]
+    assert Bytes48 is Bytes48
+
+
+def test_hashability():
+    s = {hash_tree_root(Fixed()), Bytes32(), uint64(1)}
+    assert len(s) >= 2
+
+
+# --- review-finding regressions ---
+
+def test_concat_gindex_floor():
+    from consensus_specs_tpu.ssz import concat_generalized_indices
+    assert concat_generalized_indices(2, 3) == 5   # node 2's right child
+    assert concat_generalized_indices(3, 6) == 14
+    assert concat_generalized_indices(1, 7) == 7
+    assert concat_generalized_indices(4, 4) == 16
+
+
+def test_bytevector_rejects_wrong_length():
+    with pytest.raises(ValueError):
+        deserialize(Bytes32, b"")
+    with pytest.raises(ValueError):
+        deserialize(Bytes32, b"\x00" * 31)
+    assert Bytes32() == b"\x00" * 32  # no-arg default still zeros
+
+
+def test_slice_assignment_preserves_invariants():
+    l = List[uint64, 4](1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        l[0:0] = [9, 9, 9]
+    assert len(l) == 4
+    l[0:2] = [7, 8]
+    assert list(l) == [7, 8, 3, 4]
+    v = Vector[uint64, 4](1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        v[0:2] = [9]
+    assert len(v) == 4
+
+
+def test_proof_below_absent_slot_raises():
+    class P(Container):
+        x: uint64
+        y: uint64
+
+    class Holder(Container):
+        l: List[P, 8]
+        pad: uint64
+
+    h = Holder(l=[P(x=1, y=2)])
+    gi = get_generalized_index(Holder, "l", 5, "x")
+    with pytest.raises(ValueError):
+        build_proof(h, gi)
+    # but proving the absent slot itself (a zero chunk) works
+    gi_slot = get_generalized_index(Holder, "l", 5)
+    proof = build_proof(h, gi_slot)
+    depth = get_generalized_index_length(gi_slot)
+    assert is_valid_merkle_branch(
+        b"\x00" * 32, proof, depth, gi_slot - (1 << depth), hash_tree_root(h))
