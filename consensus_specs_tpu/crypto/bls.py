@@ -1,0 +1,98 @@
+"""BLS shim: the single boundary all spec code calls for BLS operations.
+
+Reference parity: eth2spec/utils/bls.py — the switchable-backend module with
+the global `bls_active` kill-switch (:6), backend selection (:17-30), the
+`only_with_bls` decorator (:33-44) and the operation surface (:47-110).
+
+Backends:
+- "py"  : pure-Python oracle (crypto/bls_sig.py) — correctness reference.
+- "jax" : batched device kernels (ops/bls_jax.py) for bulk verification;
+          falls back to "py" per-op until the kernel set is complete.
+
+When `bls_active` is False every operation returns a stub success/zero value,
+letting the spec-test matrix run fast without real crypto — the same contract
+the reference's tests rely on (`--disable-bls`).
+"""
+from __future__ import annotations
+
+from . import bls_sig as _py
+
+bls_active = True
+_backend = "py"
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+G2_POINT_AT_INFINITY = _py.G2_POINT_AT_INFINITY
+STUB_COORDINATES = (0, 0)
+
+
+def use_py():
+    global _backend
+    _backend = "py"
+
+
+def use_jax():
+    raise NotImplementedError(
+        "jax BLS backend not wired up yet (ops/bls_jax.py pending); "
+        "the pure-Python backend is active"
+    )
+
+
+def backend() -> str:
+    return _backend
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: skip the wrapped op (returning `alt_return`) when BLS is off."""
+    def decorator(fn):
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+        wrapper.__name__ = fn.__name__
+        return wrapper
+    return decorator
+
+
+@only_with_bls(alt_return=True)
+def Verify(pubkey, message, signature) -> bool:
+    return _py.Verify(pubkey, message, signature)
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pubkeys, messages, signature) -> bool:
+    return _py.AggregateVerify(pubkeys, messages, signature)
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pubkeys, message, signature) -> bool:
+    return _py.FastAggregateVerify(pubkeys, message, signature)
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures) -> bytes:
+    return _py.Aggregate(signatures)
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(privkey, message) -> bytes:
+    return _py.Sign(int(privkey), message)
+
+
+@only_with_bls(alt_return=STUB_COORDINATES)
+def signature_to_G2(signature):
+    return _py.signature_to_point(signature)
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys) -> bytes:
+    return _py.AggregatePKs(pubkeys)
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def SkToPk(privkey) -> bytes:
+    return _py.SkToPk(int(privkey))
+
+
+def KeyValidate(pubkey) -> bool:
+    return _py.KeyValidate(pubkey)

@@ -622,7 +622,7 @@ class _Sequence(SSZType):
         if len(data) == 0:
             return []
         first_offset = int.from_bytes(data[:OFFSET_BYTE_LENGTH], "little")
-        if first_offset % OFFSET_BYTE_LENGTH != 0 or first_offset == 0:
+        if first_offset % OFFSET_BYTE_LENGTH != 0 or first_offset == 0 or first_offset > len(data):
             raise ValueError(f"{cls.__name__}: invalid first offset {first_offset}")
         count = first_offset // OFFSET_BYTE_LENGTH
         offsets = [int.from_bytes(data[i * 4:(i + 1) * 4], "little") for i in range(count)]
@@ -936,6 +936,8 @@ class Union(SSZType, metaclass=_ParamMeta):
         opts = tuple(None if p is type(None) else p for p in params)
         if opts and opts[0] is None and len(opts) == 1:
             raise TypeError("Union[None] alone is invalid")
+        if any(o is None for o in opts[1:]):
+            raise TypeError("Union: None only allowed as the first option (SSZ rule)")
         return type(f"Union[{names}]", (Union,), {"OPTIONS": opts})
 
     def __init__(self, selector: int, value=None):

@@ -351,3 +351,15 @@ def test_proof_below_absent_slot_raises():
     depth = get_generalized_index_length(gi_slot)
     assert is_valid_merkle_branch(
         b"\x00" * 32, proof, depth, gi_slot - (1 << depth), hash_tree_root(h))
+
+
+def test_decode_offset_bomb_rejected():
+    # 4-byte input claiming a huge first offset must be rejected cheaply
+    L = List[ByteList[100], 100]
+    with pytest.raises(ValueError):
+        deserialize(L, bytes.fromhex("fcffffff"))
+
+
+def test_union_none_only_first():
+    with pytest.raises(TypeError):
+        Union[uint64, None]
