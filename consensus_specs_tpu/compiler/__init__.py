@@ -1,0 +1,1 @@
+from .spec_compiler import build_spec, get_spec, parse_spec_markdown  # noqa: F401

@@ -16,6 +16,10 @@ the reference's tests rely on (`--disable-bls`).
 from __future__ import annotations
 
 from . import bls_sig as _py
+# Surfaced so consumers can detect the current map_to_curve interop status
+# (False until crypto/isogeny.py lands: signatures are internally consistent
+# but not RFC-9380-interoperable; see crypto/hash_to_curve.py docstring).
+from .hash_to_curve import MAP_TO_CURVE_RFC_COMPLIANT  # noqa: F401
 
 bls_active = True
 _backend = "py"

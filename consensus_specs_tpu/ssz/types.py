@@ -430,7 +430,14 @@ class Bitvector(SSZType, metaclass=_ParamMeta):
         return self._bits[i]
 
     def __setitem__(self, i, v):
-        self._bits[i] = bool(v)
+        if isinstance(i, slice):
+            new = list(self._bits)
+            new[i] = [bool(b) for b in v]
+            if len(new) != self.LENGTH:
+                raise ValueError(f"{type(self).__name__}: slice assignment would change length")
+            self._bits = new
+        else:
+            self._bits[i] = bool(v)
 
     def __iter__(self):
         return iter(self._bits)

@@ -1,0 +1,167 @@
+"""Attestation scenario builders (reference parity: test/helpers/attestations.py)."""
+from __future__ import annotations
+
+from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+from .keys import pubkey_to_privkey
+from ..crypto import bls
+
+
+def build_attestation_data(spec, state, slot, index):
+    assert state.slot >= slot
+
+    if slot == state.slot:
+        block_root = build_empty_block_for_next_slot(spec, state).parent_root
+    else:
+        block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        source = state.previous_justified_checkpoint
+    else:
+        source = state.current_justified_checkpoint
+
+    return spec.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=block_root,
+        source=source,
+        target=spec.Checkpoint(epoch=spec.compute_epoch_at_slot(slot), root=epoch_boundary_root),
+    )
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def sign_aggregate_attestation(spec, state, attestation_data, participants):
+    signatures = [
+        get_attestation_signature(
+            spec, state, attestation_data,
+            pubkey_to_privkey(state.validators[participant].pubkey),
+        )
+        for participant in participants
+    ]
+    if not bls.bls_active:
+        return bls.STUB_SIGNATURE
+    return bls.Aggregate(signatures)
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)
+    attestation.signature = sign_aggregate_attestation(
+        spec, state, attestation.data, sorted(participants))
+
+
+def get_valid_attestation(spec, state, slot=None, index=None,
+                          filter_participant_set=None, signed=False):
+    """A valid (optionally signed) full-committee attestation for `slot`."""
+    if slot is None:
+        slot = state.slot
+    if index is None:
+        index = 0
+    slot = spec.Slot(slot)
+    index = spec.CommitteeIndex(index)
+
+    attestation_data = build_attestation_data(spec, state, slot=slot, index=index)
+    committee = spec.get_beacon_committee(state, attestation_data.slot, attestation_data.index)
+    committee_size = len(committee)
+    participants = set(committee)
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+
+    aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        *([0b0] * committee_size))
+    for i, validator_index in enumerate(committee):
+        if validator_index in participants:
+            aggregation_bits[i] = True
+
+    attestation = spec.Attestation(
+        aggregation_bits=aggregation_bits,
+        data=attestation_data,
+    )
+    if signed and participants:
+        sign_attestation(spec, state, attestation)
+    return attestation
+
+
+def get_valid_attestations_at_slot(spec, state, slot, participation_fn=None, signed=False):
+    """One attestation per committee at `slot`."""
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot))
+    return [
+        get_valid_attestation(
+            spec, state, slot=slot, index=index,
+            filter_participant_set=participation_fn, signed=signed,
+        )
+        for index in range(committees_per_slot)
+    ]
+
+
+def state_transition_with_full_block(spec, state, fill_cur_epoch, fill_prev_epoch,
+                                     participation_fn=None, signed=False):
+    """Build, apply, and return a signed block carrying the attestations the
+    caller asked for (reference parity: attestations.py's same-named helper)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    if fill_cur_epoch and state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(spec.get_current_epoch(state)):
+            for attestation in get_valid_attestations_at_slot(
+                    spec, state, slot_to_attest, participation_fn, signed=signed):
+                block.body.attestations.append(attestation)
+    if fill_prev_epoch and state.slot >= spec.SLOTS_PER_EPOCH:
+        slot_to_attest = state.slot - spec.SLOTS_PER_EPOCH + 1
+        for attestation in get_valid_attestations_at_slot(
+                spec, state, slot_to_attest, participation_fn, signed=signed):
+            block.body.attestations.append(attestation)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch, fill_prev_epoch,
+                                 participation_fn=None):
+    """Advance one epoch via blocks full of attestations.
+    Returns (pre_state, signed_blocks, post_state)."""
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+    pre_state = state.copy()
+    signed_blocks = []
+    for _ in range(int(spec.SLOTS_PER_EPOCH)):
+        signed_blocks.append(state_transition_with_full_block(
+            spec, state, fill_cur_epoch, fill_prev_epoch, participation_fn))
+    return pre_state, signed_blocks, state
+
+
+def add_attestations_for_epoch(spec, state, epoch):
+    """Synthesize full-participation PendingAttestations for every committee
+    of `epoch` directly into the state (fast path for epoch-processing tests)."""
+    start_slot = spec.compute_start_slot_at_epoch(epoch)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    is_current = epoch == spec.get_current_epoch(state)
+    target_list = state.current_epoch_attestations if is_current else state.previous_epoch_attestations
+    source = (state.current_justified_checkpoint if is_current
+              else state.previous_justified_checkpoint)
+    for slot in range(int(start_slot), min(int(start_slot) + int(spec.SLOTS_PER_EPOCH), int(state.slot))):
+        for index in range(int(committees_per_slot)):
+            committee = spec.get_beacon_committee(
+                state, spec.Slot(slot), spec.CommitteeIndex(index))
+            data = spec.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=spec.get_block_root_at_slot(state, spec.Slot(slot)),
+                source=source,
+                target=spec.Checkpoint(epoch=epoch, root=spec.get_block_root(state, epoch)),
+            )
+            target_list.append(spec.PendingAttestation(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                inclusion_delay=1,
+                proposer_index=spec.get_beacon_proposer_index(state),
+            ))

@@ -1,0 +1,92 @@
+"""Block-construction helpers (reference parity: test/helpers/block.py)."""
+from __future__ import annotations
+
+from .keys import pubkey_to_privkey
+from ..crypto import bls
+
+
+def get_proposer_privkey(spec, state, proposer_index=None):
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    return pubkey_to_privkey(state.validators[proposer_index].pubkey)
+
+
+def apply_randao_reveal(spec, state, block):
+    assert state.slot <= block.slot
+    proposer_state = state
+    if state.slot < block.slot:
+        proposer_state = state.copy()
+        spec.process_slots(proposer_state, block.slot)
+    privkey = get_proposer_privkey(spec, proposer_state, block.proposer_index)
+    epoch = spec.get_current_epoch(proposer_state)
+    domain = spec.get_domain(proposer_state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.Epoch(epoch), domain)
+    block.body.randao_reveal = bls.Sign(privkey, signing_root)
+
+
+def build_empty_block(spec, state, slot=None):
+    if slot is None:
+        slot = state.slot
+    if slot < state.slot:
+        raise ValueError("cannot build a block for a past slot")
+    if state.slot < slot:
+        state = state.copy()
+        spec.process_slots(state, slot)
+
+    block = spec.BeaconBlock()
+    block.slot = slot
+    block.proposer_index = spec.get_beacon_proposer_index(state)
+    block.parent_root = spec.hash_tree_root(state.latest_block_header)
+    block.body.eth1_data.deposit_count = state.eth1_deposit_index
+    if spec.fork == "bellatrix":
+        block.body.execution_payload = spec.ExecutionPayload()
+    apply_randao_reveal(spec, state, block)
+    return block
+
+
+def build_empty_block_for_next_slot(spec, state):
+    return build_empty_block(spec, state, state.slot + 1)
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    if proposer_index is None:
+        proposer_index = block.proposer_index
+    privkey = pubkey_to_privkey(state.validators[proposer_index].pubkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot)
+    )
+    signing_root = spec.compute_signing_root(block, domain)
+    return spec.SignedBeaconBlock(message=block, signature=bls.Sign(privkey, signing_root))
+
+
+def transition_unsigned_block(spec, state, block):
+    assert state.slot < block.slot
+    spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+
+
+def state_transition_and_sign_block(spec, state, block, expect_fail=False):
+    """Advance `state` through `block`, fill in the resulting state root, and
+    return the signed block (the standard valid-block test flow)."""
+    pre_state = state.copy()
+    transition_unsigned_block(spec, state, block)
+    block.state_root = spec.hash_tree_root(state)
+    signed_block = sign_block(spec, pre_state, block)
+    # The full transition (with signature checks) must agree.
+    check_state = pre_state
+    spec.state_transition(check_state, signed_block, validate_result=True)
+    assert spec.hash_tree_root(check_state) == spec.hash_tree_root(state)
+    return signed_block
+
+
+def apply_empty_block(spec, state, slot=None):
+    if slot is None:
+        slot = state.slot + 1
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def next_epoch_via_block(spec, state):
+    return apply_empty_block(
+        spec, state, state.slot + spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH
+    )

@@ -1,0 +1,82 @@
+"""Genesis-state factory for tests.
+
+Reference parity: helpers/genesis.py create_genesis_state (:42) — builds a
+valid post-genesis BeaconState directly (without replaying deposit proofs),
+with deterministic keypairs and full effective balances activated at genesis.
+"""
+from __future__ import annotations
+
+from .keys import get_pubkeys
+
+
+def build_mock_validator(spec, i: int, balance: int):
+    pubkey = get_pubkeys()[i]
+    withdrawal_credentials = (
+        bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pubkey)[1:]
+    )
+    validator = spec.Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=min(
+            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+            spec.MAX_EFFECTIVE_BALANCE,
+        ),
+    )
+    return validator
+
+
+def create_genesis_state(spec, validator_balances, activation_threshold=None):
+    if activation_threshold is None:
+        activation_threshold = spec.MAX_EFFECTIVE_BALANCE
+    deposit_root = b"\x42" * 32
+    eth1_block_hash = b"\xda" * 32
+    state = spec.BeaconState(
+        genesis_time=spec.config.MIN_GENESIS_TIME,
+        eth1_deposit_index=len(validator_balances),
+        eth1_data=spec.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=len(validator_balances),
+            block_hash=eth1_block_hash,
+        ),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=spec.hash_tree_root(spec.BeaconBlockBody())
+        ),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    for i, balance in enumerate(validator_balances):
+        validator = build_mock_validator(spec, i, balance)
+        state.validators.append(validator)
+        state.balances.append(balance)
+        if validator.effective_balance >= activation_threshold:
+            validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            validator.activation_epoch = spec.GENESIS_EPOCH
+
+    state.genesis_validators_root = spec.hash_tree_root(state.validators)
+
+    if spec.fork != "phase0":
+        # Altair+: fill participation/inactivity and the first sync committees.
+        state.previous_epoch_participation = [
+            spec.ParticipationFlags(0) for _ in validator_balances
+        ]
+        state.current_epoch_participation = [
+            spec.ParticipationFlags(0) for _ in validator_balances
+        ]
+        state.inactivity_scores = [spec.uint64(0) for _ in validator_balances]
+        state.current_sync_committee = spec.get_next_sync_committee(state)
+        state.next_sync_committee = spec.get_next_sync_committee(state)
+
+    if spec.fork == "bellatrix":
+        state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+
+    return state
+
+
+def create_valid_beacon_state(spec, num_validators=None):
+    n = num_validators or spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    balances = [spec.MAX_EFFECTIVE_BALANCE] * n
+    return create_genesis_state(spec, balances)

@@ -1,0 +1,45 @@
+"""Deterministic test keypairs.
+
+Reference parity: eth2spec test helpers' key fixtures
+(tests/core/pyspec/eth2spec/test/helpers/keys.py:4-6) — privkeys are small
+consecutive integers, pubkeys derived once and cached. Small scalars keep the
+pure-Python G1 multiplications cheap (bit-length-bounded double-and-add).
+"""
+from __future__ import annotations
+
+from ..crypto import bls_sig
+
+NUM_KEYS = 512  # enough for minimal-preset test worlds (64..256 validators)
+
+privkeys = [i + 1 for i in range(NUM_KEYS)]
+
+_pubkey_cache: list[bytes] | None = None
+_pubkey_to_privkey: dict[bytes, int] | None = None
+
+
+def get_pubkeys() -> list[bytes]:
+    global _pubkey_cache
+    if _pubkey_cache is None:
+        _pubkey_cache = [bls_sig.SkToPk(k) for k in privkeys]
+    return _pubkey_cache
+
+
+def pubkey_to_privkey(pubkey: bytes) -> int:
+    global _pubkey_to_privkey
+    if _pubkey_to_privkey is None:
+        _pubkey_to_privkey = {pk: sk for pk, sk in zip(get_pubkeys(), privkeys)}
+    return _pubkey_to_privkey[bytes(pubkey)]
+
+
+class _LazyPubkeys:
+    def __getitem__(self, i):
+        return get_pubkeys()[i]
+
+    def __len__(self):
+        return NUM_KEYS
+
+    def __iter__(self):
+        return iter(get_pubkeys())
+
+
+pubkeys = _LazyPubkeys()

@@ -1,0 +1,39 @@
+"""State-advancement helpers (reference parity: test/helpers/state.py)."""
+from __future__ import annotations
+
+
+def next_slot(spec, state):
+    spec.process_slots(state, state.slot + 1)
+
+
+def next_slots(spec, state, slots):
+    if slots > 0:
+        spec.process_slots(state, state.slot + slots)
+
+
+def next_epoch(spec, state):
+    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, slot)
+
+
+def transition_to(spec, state, slot):
+    assert state.slot <= slot
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+
+
+def transition_to_slot_via_block(spec, state, slot):
+    from .block import apply_empty_block
+    assert state.slot < slot
+    apply_empty_block(spec, state, slot)
+
+
+def get_balance(state, index):
+    return state.balances[index]
+
+
+def set_full_participation_previous_epoch(spec, state):
+    """Make every active validator appear to have attested correctly for the
+    previous epoch (phase0: synthetic PendingAttestations)."""
+    from .attestations import add_attestations_for_epoch
+    add_attestations_for_epoch(spec, state, spec.get_previous_epoch(state))

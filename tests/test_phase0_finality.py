@@ -1,0 +1,119 @@
+"""FFG justification and finalization over multi-epoch attestation flows.
+
+Reference parity: test/phase0/finality/test_finality.py and
+epoch_processing/test_process_justification_and_finalization.py behavior.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.attestations import (
+    get_valid_attestation, next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_epoch
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    bls.bls_active = False
+    yield
+    bls.bls_active = True
+
+
+def test_finality_from_full_participation(spec):
+    state = create_valid_beacon_state(spec, 64)
+    # Epoch 0: no attestations yet.
+    next_epoch(spec, state)
+    assert state.finalized_checkpoint.epoch == 0
+    # Several epochs with full attestation participation.
+    for _ in range(4):
+        next_epoch_with_attestations(spec, state, fill_cur_epoch=True, fill_prev_epoch=False)
+    # With full participation, justification happens every epoch and
+    # finalization follows one epoch behind.
+    assert state.current_justified_checkpoint.epoch >= 3
+    assert state.finalized_checkpoint.epoch >= 2
+    assert state.finalized_checkpoint.epoch == state.current_justified_checkpoint.epoch - 1
+
+
+def test_no_attestations_no_finality(spec):
+    state = create_valid_beacon_state(spec, 64)
+    for _ in range(4):
+        next_epoch(spec, state)
+    assert state.current_justified_checkpoint.epoch == 0
+    assert state.finalized_checkpoint.epoch == 0
+
+
+def test_partial_participation_no_justification(spec):
+    state = create_valid_beacon_state(spec, 64)
+    next_epoch(spec, state)
+
+    # Under 2/3 participation: keep only ~half of each committee.
+    def halve(participants):
+        return set(sorted(participants)[: len(participants) // 2])
+
+    for _ in range(3):
+        next_epoch_with_attestations(
+            spec, state, fill_cur_epoch=True, fill_prev_epoch=False, participation_fn=halve)
+    assert state.current_justified_checkpoint.epoch == 0
+    assert state.finalized_checkpoint.epoch == 0
+
+
+def test_rewards_applied_for_participation(spec):
+    state = create_valid_beacon_state(spec, 64)
+    next_epoch(spec, state)
+    balances_before = [int(b) for b in state.balances]
+    for _ in range(3):
+        next_epoch_with_attestations(spec, state, fill_cur_epoch=True, fill_prev_epoch=False)
+    balances_after = [int(b) for b in state.balances]
+    # Everyone participated fully: total balance must strictly increase.
+    assert sum(balances_after) > sum(balances_before)
+
+
+def test_attestation_deltas_penalize_absent(spec):
+    state = create_valid_beacon_state(spec, 64)
+    next_epoch(spec, state)
+
+    quarter = lambda participants: set(sorted(participants)[: max(1, len(participants) // 4)])
+    for _ in range(3):
+        next_epoch_with_attestations(
+            spec, state, fill_cur_epoch=True, fill_prev_epoch=False, participation_fn=quarter)
+
+    rewards, penalties = spec.get_attestation_deltas(state)
+    assert any(int(p) > 0 for p in penalties)
+
+
+def test_process_attestation_updates_state(spec):
+    from consensus_specs_tpu.testlib.state import next_slots
+
+    state = create_valid_beacon_state(spec, 64)
+    next_epoch(spec, state)
+    next_slots(spec, state, 1)
+    # state.slot - 1 is now inside the current epoch
+    attestation = get_valid_attestation(spec, state, slot=state.slot - 1)
+    assert attestation.data.target.epoch == spec.get_current_epoch(state)
+    spec.process_attestation(state, attestation)
+    assert len(state.current_epoch_attestations) == 1
+    pa = state.current_epoch_attestations[0]
+    assert pa.data == attestation.data
+    assert pa.inclusion_delay == 1
+
+    # previous-epoch attestation lands in the other bucket
+    prev = get_valid_attestation(spec, state, slot=spec.SLOTS_PER_EPOCH - 1)
+    assert prev.data.target.epoch == spec.get_previous_epoch(state)
+    spec.process_attestation(state, prev)
+    assert len(state.previous_epoch_attestations) == 1
+
+
+def test_process_attestation_bad_source_rejected(spec):
+    state = create_valid_beacon_state(spec, 64)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot - 1)
+    attestation.data.source = spec.Checkpoint(epoch=5, root=b"\x66" * 32)
+    with pytest.raises(AssertionError):
+        spec.process_attestation(state, attestation)

@@ -1,0 +1,132 @@
+"""Fork choice: store init, on_block/on_tick/on_attestation, get_head.
+
+Reference parity: test/phase0/fork_choice/ (test_get_head.py, test_on_block.py)
+— scripted single-store simulation of multi-peer behavior.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.attestations import get_valid_attestation
+from consensus_specs_tpu.testlib.block import (
+    apply_empty_block, build_empty_block, sign_block, state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    bls.bls_active = False
+    yield
+    bls.bls_active = True
+
+
+def get_genesis_forkchoice_store_and_block(spec, state):
+    assert state.slot == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    return spec.get_forkchoice_store(state, genesis_block), genesis_block
+
+
+def tick_to_slot(spec, store, slot):
+    spec.on_tick(store, store.genesis_time + int(slot) * spec.config.SECONDS_PER_SLOT)
+
+
+def test_genesis_head(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, genesis_block = get_genesis_forkchoice_store_and_block(spec, state)
+    assert spec.get_head(store) == spec.hash_tree_root(genesis_block)
+
+
+def test_chain_head_follows_blocks(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    for slot in range(1, 4):
+        block = build_empty_block(spec, state, slot)
+        signed = state_transition_and_sign_block(spec, state, block)
+        tick_to_slot(spec, store, slot)
+        spec.on_block(store, signed)
+        assert spec.get_head(store) == spec.hash_tree_root(block)
+    assert store.blocks[spec.get_head(store)].slot == 3
+
+
+def test_on_block_future_slot_rejected(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block(spec, state, 2)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # store clock still at slot 0
+    with pytest.raises(AssertionError):
+        spec.on_block(store, signed)
+
+
+def test_on_block_unknown_parent_rejected(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    tick_to_slot(spec, store, 2)
+    block = build_empty_block(spec, state, 1)
+    block.parent_root = b"\x99" * 32
+    signed = sign_block(spec, state, block)
+    with pytest.raises((AssertionError, KeyError)):
+        spec.on_block(store, signed)
+
+
+def test_fork_attestations_decide_head(spec):
+    """Two competing branches; the attested one wins LMD-GHOST."""
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+
+    # Branch A: block at slot 1 (empty graffiti)
+    state_a = state.copy()
+    block_a = build_empty_block(spec, state_a, 1)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+
+    # Branch B: different block at slot 1
+    state_b = state.copy()
+    block_b = build_empty_block(spec, state_b, 1)
+    block_b.body.graffiti = b"\x01" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+
+    # Arrive late in the slot (past the attesting interval) so neither block
+    # earns the proposer boost and pure tie-breaking applies.
+    spec.on_tick(store, store.genesis_time
+                 + 1 * spec.config.SECONDS_PER_SLOT
+                 + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT + 1)
+    spec.on_block(store, signed_a)
+    spec.on_block(store, signed_b)
+    assert store.proposer_boost_root == spec.Root()
+    root_a = spec.hash_tree_root(block_a)
+    root_b = spec.hash_tree_root(block_b)
+
+    # No attestations: tie-break by highest root.
+    expected_tiebreak = max([root_a, root_b])
+    assert spec.get_head(store) == expected_tiebreak
+
+    # Attest for the loser of the tie-break; it must become the head.
+    loser_root = min([root_a, root_b])
+    loser_state = state_a if loser_root == root_a else state_b
+    next_slots(spec, loser_state, 1)
+    attestation = get_valid_attestation(spec, loser_state, slot=1)
+    assert attestation.data.beacon_block_root == loser_root
+    tick_to_slot(spec, store, 2)
+    spec.on_attestation(store, attestation)
+    assert spec.get_head(store) == loser_root
+
+
+def test_proposer_boost_on_timely_block(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    block = build_empty_block(spec, state, 1)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # Arrive exactly at the start of slot 1 (timely)
+    tick_to_slot(spec, store, 1)
+    spec.on_block(store, signed)
+    assert store.proposer_boost_root == spec.hash_tree_root(block)
+    # Boost resets on next slot tick
+    tick_to_slot(spec, store, 2)
+    assert store.proposer_boost_root == spec.Root()

@@ -1,0 +1,130 @@
+"""Phase0 end-to-end sanity: genesis -> slots -> blocks -> epochs.
+
+Reference parity: the role of tests/core/pyspec/eth2spec/test/phase0/sanity/
+(test_blocks.py, test_slots.py) on the minimal preset.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.block import (
+    apply_empty_block, build_empty_block_for_next_slot, sign_block,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_epoch, next_slot, next_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture()
+def state(spec):
+    return create_valid_beacon_state(spec, 64)
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    bls.bls_active = False
+    yield
+    bls.bls_active = True
+
+
+def test_genesis_state_valid(spec, state):
+    assert len(state.validators) == 64
+    assert spec.is_valid_genesis_state(state)
+    active = spec.get_active_validator_indices(state, spec.GENESIS_EPOCH)
+    assert len(active) == 64
+    assert state.validators[0].activation_epoch == spec.GENESIS_EPOCH
+
+
+def test_slot_transition_changes_root(spec, state):
+    root_before = spec.hash_tree_root(state)
+    next_slot(spec, state)
+    assert state.slot == 1
+    assert spec.hash_tree_root(state) != root_before
+    # state root of slot 0 recorded
+    assert state.state_roots[0] == root_before
+
+
+def test_empty_block_transition(spec, state):
+    signed = apply_empty_block(spec, state)
+    assert state.slot == 1
+    assert signed.message.state_root == spec.hash_tree_root(state)
+    assert state.latest_block_header.slot == 1
+
+
+def test_skipped_slots_then_block(spec, state):
+    next_slots(spec, state, 3)
+    signed = apply_empty_block(spec, state)
+    assert state.slot == 4
+    assert signed.message.slot == 4
+
+
+def test_epoch_boundary_transition(spec, state):
+    next_epoch(spec, state)
+    assert state.slot == spec.SLOTS_PER_EPOCH
+    assert spec.get_current_epoch(state) == 1
+
+
+def test_multi_epoch_with_blocks(spec, state):
+    for _ in range(int(spec.SLOTS_PER_EPOCH) * 2 + 1):
+        apply_empty_block(spec, state)
+    assert spec.get_current_epoch(state) == 2
+    # block roots chain: every block's parent is the previous block
+    r1 = state.block_roots[1]
+    r2 = state.block_roots[2]
+    assert r1 != r2
+
+
+def test_proposer_index_deterministic(spec, state):
+    next_slot(spec, state)
+    p1 = spec.get_beacon_proposer_index(state)
+    p2 = spec.get_beacon_proposer_index(state)
+    assert p1 == p2
+    assert 0 <= p1 < 64
+
+
+def test_invalid_state_root_rejected(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.state_root = b"\x13" * 32
+    signed = sign_block(spec, state, block)
+    with pytest.raises(AssertionError):
+        spec.state_transition(state, signed, validate_result=True)
+
+
+def test_prev_slot_block_rejected(spec, state):
+    next_slots(spec, state, 2)
+    block = spec.BeaconBlock(slot=1)
+    signed = sign_block(spec, state, block)
+    with pytest.raises(AssertionError):
+        spec.state_transition(state, signed)
+
+
+def test_committees_cover_all_validators(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    seen = set()
+    for slot in range(int(spec.SLOTS_PER_EPOCH)):
+        for index in range(int(committees_per_slot)):
+            comm = spec.get_beacon_committee(
+                state, spec.Slot(slot), spec.CommitteeIndex(index))
+            assert len(comm) > 0
+            seen.update(int(i) for i in comm)
+    assert seen == set(range(64))
+
+
+def test_bls_on_single_block():
+    """One real-BLS block transition (randao + proposer signature)."""
+    spec = get_spec("phase0", "minimal")
+    bls.bls_active = True
+    state = create_valid_beacon_state(spec, 64)
+    signed = apply_empty_block(spec, state)
+    assert state.slot == 1
+    # tampered signature must fail
+    state2 = create_valid_beacon_state(spec, 64)
+    bad = spec.SignedBeaconBlock(message=signed.message, signature=b"\x11" * 96)
+    with pytest.raises(AssertionError):
+        spec.state_transition(state2, bad)
