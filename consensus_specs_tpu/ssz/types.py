@@ -588,6 +588,10 @@ class _Sequence(SSZType):
         raise NotImplementedError
 
     def __eq__(self, other):
+        if isinstance(other, (list, tuple)):
+            # Spec code compares SSZ sequences against plain-list literals.
+            return len(self._elems) == len(other) and all(
+                a == b for a, b in zip(self._elems, other))
         return type(self) is type(other) and self._elems == other._elems
 
     def __hash__(self):
@@ -844,7 +848,10 @@ class Container(SSZType):
     def coerce(cls, v):
         if isinstance(v, cls):
             return v
-        if isinstance(v, Container) and type(v).fields() == cls.fields():
+        # Structural coercion: same field names => rebuild field-by-field
+        # (each field recursively coerced). Needed for cross-fork/cross-module
+        # upgrades where equivalent container classes are distinct objects.
+        if isinstance(v, Container) and list(type(v).fields().keys()) == list(cls.fields().keys()):
             return cls(**{n: getattr(v, n) for n in cls.fields()})
         raise TypeError(f"cannot coerce {type(v).__name__} to {cls.__name__}")
 

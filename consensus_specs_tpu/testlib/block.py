@@ -38,10 +38,38 @@ def build_empty_block(spec, state, slot=None):
     block.proposer_index = spec.get_beacon_proposer_index(state)
     block.parent_root = spec.hash_tree_root(state.latest_block_header)
     block.body.eth1_data.deposit_count = state.eth1_deposit_index
+    if spec.fork != "phase0":
+        # Empty-participation sync aggregate: valid with the infinity signature
+        block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
     if spec.fork == "bellatrix":
-        block.body.execution_payload = spec.ExecutionPayload()
+        if spec.is_merge_transition_complete(state):
+            block.body.execution_payload = build_empty_execution_payload(spec, state)
+        else:
+            block.body.execution_payload = spec.ExecutionPayload()
     apply_randao_reveal(spec, state, block)
     return block
+
+
+def build_empty_execution_payload(spec, state):
+    """A payload that passes process_execution_payload's consistency asserts
+    for the post-merge `state` (reference parity: helpers/execution_payload.py
+    build_empty_execution_payload)."""
+    latest = state.latest_execution_payload_header
+    payload = spec.ExecutionPayload(
+        parent_hash=latest.block_hash,
+        fee_recipient=spec.ExecutionAddress(),
+        state_root=latest.state_root,
+        receipt_root=b"\x2a" * 32,
+        logs_bloom=spec.ByteVector[spec.BYTES_PER_LOGS_BLOOM](),
+        random=spec.get_randao_mix(state, spec.get_current_epoch(state)),
+        block_number=latest.block_number + 1,
+        gas_limit=latest.gas_limit,
+        gas_used=0,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=latest.base_fee_per_gas,
+    )
+    payload.block_hash = spec.Hash32(spec.hash(spec.hash_tree_root(payload) + b"FAKE RLP HASH"))
+    return payload
 
 
 def build_empty_block_for_next_slot(spec, state):

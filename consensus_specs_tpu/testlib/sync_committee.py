@@ -1,0 +1,59 @@
+"""Sync-committee scenario builders (reference parity: test/helpers/sync_committee.py)."""
+from __future__ import annotations
+
+from .keys import pubkey_to_privkey
+from ..crypto import bls
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None):
+    domain = spec.get_domain(state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot))
+    if block_root is None:
+        if slot == state.slot:
+            block_root = spec.hash_tree_root(state.latest_block_header)
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def compute_aggregate_sync_committee_signature(spec, state, slot, participants, block_root=None):
+    if len(participants) == 0:
+        return spec.G2_POINT_AT_INFINITY
+    signatures = [
+        compute_sync_committee_signature(
+            spec, state, slot,
+            pubkey_to_privkey(state.validators[participant].pubkey),
+            block_root=block_root,
+        )
+        for participant in participants
+    ]
+    if not bls.bls_active:
+        return bls.STUB_SIGNATURE
+    return bls.Aggregate(signatures)
+
+
+def get_committee_indices(spec, state):
+    """Validator indices of the current sync committee, in committee order."""
+    all_pubkeys = [v.pubkey for v in state.validators]
+    return [
+        spec.ValidatorIndex(all_pubkeys.index(pubkey))
+        for pubkey in state.current_sync_committee.pubkeys
+    ]
+
+
+def build_sync_aggregate(spec, state, participation=None, slot=None):
+    """SyncAggregate over the previous slot's block root with the given
+    per-member participation bools (default: full participation)."""
+    if participation is None:
+        participation = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    if slot is None:
+        slot = state.slot
+    committee_indices = get_committee_indices(spec, state)
+    participants = [idx for idx, bit in zip(committee_indices, participation) if bit]
+    previous_slot = max(int(slot), 1) - 1
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, spec.Slot(previous_slot), participants)
+    return spec.SyncAggregate(
+        sync_committee_bits=participation,
+        sync_committee_signature=signature,
+    )

@@ -1,0 +1,127 @@
+"""Bellatrix: execution payloads, merge transition, fork upgrade.
+
+Reference parity: test/bellatrix/{block_processing/test_process_execution_payload.py,
+unittests,fork}.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.block import apply_empty_block, build_empty_block_for_next_slot
+from consensus_specs_tpu.testlib.block import state_transition_and_sign_block
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("bellatrix", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    bls.bls_active = False
+    yield
+    bls.bls_active = True
+
+
+@pytest.fixture()
+def state(spec):
+    return create_valid_beacon_state(spec, 64)
+
+
+def build_valid_payload(spec, state, parent_hash=None):
+    payload = spec.ExecutionPayload()
+    payload.parent_hash = parent_hash if parent_hash is not None else b"\xaa" * 32
+    payload.random = spec.get_randao_mix(state, spec.get_current_epoch(state))
+    payload.timestamp = spec.compute_timestamp_at_slot(state, state.slot)
+    payload.block_hash = b"\xbb" * 32
+    payload.block_number = 1
+    return payload
+
+
+def test_pre_merge_empty_payload_transition(spec, state):
+    assert not spec.is_merge_transition_complete(state)
+    apply_empty_block(spec, state)  # empty payload: execution not enabled
+    assert state.slot == 1
+    assert not spec.is_merge_transition_complete(state)
+
+
+def test_merge_transition_block(spec, state):
+    next_slots(spec, state, 1)
+    payload = build_valid_payload(spec, state)
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    assert spec.is_merge_transition_block(state, body)
+    spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+    assert spec.is_merge_transition_complete(state)
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+    assert state.latest_execution_payload_header.transactions_root == spec.hash_tree_root(payload.transactions)
+
+
+def test_post_merge_parent_hash_checked(spec, state):
+    next_slots(spec, state, 1)
+    payload = build_valid_payload(spec, state)
+    spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+    # Next payload must chain on block_hash
+    payload2 = build_valid_payload(spec, state, parent_hash=payload.block_hash)
+    payload2.block_hash = b"\xcc" * 32
+    spec.process_execution_payload(state, payload2, spec.EXECUTION_ENGINE)
+    # Broken chain rejected
+    payload3 = build_valid_payload(spec, state, parent_hash=b"\x00" * 32)
+    with pytest.raises(AssertionError):
+        spec.process_execution_payload(state, payload3, spec.EXECUTION_ENGINE)
+
+
+def test_wrong_randao_or_timestamp_rejected(spec, state):
+    next_slots(spec, state, 1)
+    payload = build_valid_payload(spec, state)
+    payload.random = b"\x01" * 32
+    with pytest.raises(AssertionError):
+        spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+    payload = build_valid_payload(spec, state)
+    payload.timestamp = 12345
+    with pytest.raises(AssertionError):
+        spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+
+
+def test_block_with_payload_via_full_transition(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    state_for_payload = state.copy()
+    next_slots(spec, state_for_payload, 1)
+    block.body.execution_payload = build_valid_payload(spec, state_for_payload)
+    state_transition_and_sign_block(spec, state, block)
+    assert spec.is_merge_transition_complete(state)
+
+
+def test_upgrade_to_bellatrix(spec):
+    altair_spec = get_spec("altair", "minimal")
+    pre = create_valid_beacon_state(altair_spec, 64)
+    next_slots(altair_spec, pre, 3)
+    post = spec.upgrade_to_bellatrix(pre)
+    assert post.fork.current_version == spec.config.BELLATRIX_FORK_VERSION
+    assert post.latest_execution_payload_header == spec.ExecutionPayloadHeader()
+    assert spec.hash_tree_root(post.validators) == altair_spec.hash_tree_root(pre.validators)
+    apply_empty_block(spec, post)
+
+
+def test_terminal_pow_validation(spec):
+    ttd = spec.config.TERMINAL_TOTAL_DIFFICULTY
+    genesis_pow = spec.PowBlock(block_hash=b"\x01" * 32, parent_hash=b"\x00" * 32,
+                                total_difficulty=ttd - 1)
+    terminal = spec.PowBlock(block_hash=b"\x02" * 32, parent_hash=b"\x01" * 32,
+                             total_difficulty=ttd)
+    assert spec.is_valid_terminal_pow_block(terminal, genesis_pow)
+    assert not spec.is_valid_terminal_pow_block(genesis_pow, genesis_pow)
+    pow_chain = {bytes(b.block_hash): b for b in (genesis_pow, terminal)}
+    assert spec.get_terminal_pow_block(pow_chain) == terminal
+
+
+def test_post_merge_empty_blocks_chain(spec, state):
+    """Regression: build_empty_block must produce valid payloads post-merge."""
+    next_slots(spec, state, 1)
+    payload = build_valid_payload(spec, state)
+    spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+    assert spec.is_merge_transition_complete(state)
+    for _ in range(3):
+        apply_empty_block(spec, state)
+    assert state.latest_execution_payload_header.block_number == payload.block_number + 3
