@@ -1,0 +1,62 @@
+"""Headline benchmark: mainnet-preset 1M-validator `process_epoch` wall-clock.
+
+Target (BASELINE.md north star): < 2 s on a TPU chip for the full epoch
+registry sweep (justification, inactivity, rewards/penalties, registry churn,
+slashings, hysteresis, resets, historical-batch merkle). The reference
+publishes no numbers (BASELINE.json `published: {}`), so `vs_baseline` is the
+speedup against that 2 s target: 2.0 / measured.
+
+Prints exactly one JSON line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+N = int(os.environ.get("BENCH_VALIDATORS", 1_048_576))
+TARGET_S = 2.0
+
+
+def main() -> None:
+    import jax
+
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.engine.epoch import make_epoch_fn
+    from consensus_specs_tpu.engine.state import EpochConfig
+    from consensus_specs_tpu.engine.synthetic import synthetic_epoch_state
+
+    cfg = EpochConfig.from_spec(get_spec("altair", "mainnet"))
+    state = synthetic_epoch_state(cfg, n=N)
+    # donated buffers: keep a template to refresh inputs between timed runs
+    fn = make_epoch_fn(cfg)
+
+    t0 = time.time()
+    out, _ = fn(state)
+    jax.block_until_ready(out.balances)
+    print(f"# compile+first: {time.time() - t0:.1f}s on {jax.devices()[0]}", file=sys.stderr)
+
+    times = []
+    for _ in range(5):
+        refreshed = jax.tree.map(lambda x: x.copy(), out)
+        t0 = time.time()
+        out2, _ = fn(refreshed)
+        jax.block_until_ready(out2.balances)
+        times.append(time.time() - t0)
+        out = out2
+    med = sorted(times)[len(times) // 2]
+    print(
+        json.dumps(
+            {
+                "metric": f"mainnet_altair_process_epoch_{N}_validators",
+                "value": round(med, 4),
+                "unit": "s",
+                "vs_baseline": round(TARGET_S / med, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Device epoch engine: the TPU-native twin of the spec's `process_epoch`.
+
+The executable spec (specs/{phase0,altair}/beacon-chain.md, compiled by
+consensus_specs_tpu/compiler) is object-based, scalar, and host-bound — the
+correctness oracle. This package is the performance path: the epoch-boundary
+registry math (justification/finalization, inactivity, rewards & penalties,
+registry churn, slashings, hysteresis, vector resets, historical-batch
+Merkleization) expressed over a struct-of-arrays `EpochState` pytree of device
+arrays, jitted as a single `state -> state` XLA program and shardable over a
+`jax.sharding.Mesh` along the validator axis.
+
+Reference parity map (per function) is documented in epoch.py docstrings
+against specs/phase0/beacon-chain.md and specs/altair/beacon-chain.md; the
+differential test (tests/test_epoch_engine.py) checks bit-exact agreement of
+every mutated field against the compiled altair spec.
+"""
+from .state import EpochConfig, EpochState, EpochAux
+from .epoch import make_epoch_fn
+from .bridge import state_to_device, apply_epoch_via_engine
+
+__all__ = [
+    "EpochConfig",
+    "EpochState",
+    "EpochAux",
+    "make_epoch_fn",
+    "state_to_device",
+    "apply_epoch_via_engine",
+]

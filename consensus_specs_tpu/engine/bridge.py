@@ -1,0 +1,160 @@
+"""Host bridge: spec `BeaconState` (SSZ object tree) <-> device `EpochState`.
+
+`apply_epoch_via_engine(spec, state)` is a drop-in replacement for the spec's
+`process_epoch(state)` (specs/altair/beacon-chain.md): transpose the state to
+struct-of-arrays, run the jitted device epoch program, write the mutated
+columns back, and perform the three host-side epilogue steps the device
+flags via EpochAux (eth1 vote list reset, historical-root append, sync
+committee rotation via the batched sampler).
+
+This is the conformance seam: the differential test runs both paths on the
+same randomized states and asserts the SSZ hash_tree_root of the results
+match.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .epoch import epoch_fn_for, historical_batch_root
+from .state import EpochConfig, EpochState
+from .sync_committee import next_sync_committee_indices
+
+
+from ..ops.sha256_jax import bytes_to_words, words_to_bytes
+
+
+def _roots_to_words(roots) -> np.ndarray:
+    return bytes_to_words(b"".join(bytes(r) for r in roots)).reshape(len(roots), 8)
+
+
+def _root_to_words(root: bytes) -> np.ndarray:
+    return bytes_to_words(bytes(root))
+
+
+def _words_to_root(words) -> bytes:
+    return words_to_bytes(np.asarray(words, dtype=np.uint32))
+
+
+def state_to_device(spec, state) -> tuple[EpochState, EpochConfig]:
+    """Transpose the epoch-relevant slice of a spec BeaconState to device."""
+    cfg = EpochConfig.from_spec(spec)
+    vals = state.validators
+    n = len(vals)
+    u64 = lambda xs: np.array([int(x) for x in xs], dtype=np.uint64)  # noqa: E731
+    dev = EpochState(
+        slot=jnp.uint64(int(state.slot)),
+        balances=jnp.asarray(u64(state.balances)),
+        effective_balance=jnp.asarray(u64(v.effective_balance for v in vals)),
+        activation_eligibility_epoch=jnp.asarray(u64(v.activation_eligibility_epoch for v in vals)),
+        activation_epoch=jnp.asarray(u64(v.activation_epoch for v in vals)),
+        exit_epoch=jnp.asarray(u64(v.exit_epoch for v in vals)),
+        withdrawable_epoch=jnp.asarray(u64(v.withdrawable_epoch for v in vals)),
+        slashed=jnp.asarray(np.array([bool(v.slashed) for v in vals])),
+        prev_participation=jnp.asarray(
+            np.array([int(x) for x in state.previous_epoch_participation], dtype=np.uint8)
+        ),
+        curr_participation=jnp.asarray(
+            np.array([int(x) for x in state.current_epoch_participation], dtype=np.uint8)
+        ),
+        inactivity_scores=jnp.asarray(u64(state.inactivity_scores)),
+        slashings=jnp.asarray(u64(state.slashings)),
+        randao_mixes=jnp.asarray(_roots_to_words(state.randao_mixes)),
+        block_roots=jnp.asarray(_roots_to_words(state.block_roots)),
+        state_roots=jnp.asarray(_roots_to_words(state.state_roots)),
+        justification_bits=jnp.asarray(np.array([bool(b) for b in state.justification_bits])),
+        prev_justified_epoch=jnp.uint64(int(state.previous_justified_checkpoint.epoch)),
+        prev_justified_root=jnp.asarray(_root_to_words(state.previous_justified_checkpoint.root)),
+        curr_justified_epoch=jnp.uint64(int(state.current_justified_checkpoint.epoch)),
+        curr_justified_root=jnp.asarray(_root_to_words(state.current_justified_checkpoint.root)),
+        finalized_epoch=jnp.uint64(int(state.finalized_checkpoint.epoch)),
+        finalized_root=jnp.asarray(_root_to_words(state.finalized_checkpoint.root)),
+    )
+    assert n == dev.balances.shape[0]
+    return dev, cfg
+
+
+def _write_back(spec, state, dev: EpochState) -> None:
+    balances = np.asarray(dev.balances)
+    eff = np.asarray(dev.effective_balance)
+    aee = np.asarray(dev.activation_eligibility_epoch)
+    ae = np.asarray(dev.activation_epoch)
+    ee = np.asarray(dev.exit_epoch)
+    we = np.asarray(dev.withdrawable_epoch)
+    for i, v in enumerate(state.validators):
+        v.effective_balance = spec.Gwei(int(eff[i]))
+        v.activation_eligibility_epoch = spec.Epoch(int(aee[i]))
+        v.activation_epoch = spec.Epoch(int(ae[i]))
+        v.exit_epoch = spec.Epoch(int(ee[i]))
+        v.withdrawable_epoch = spec.Epoch(int(we[i]))
+    state.balances = type(state.balances)(*[spec.Gwei(int(b)) for b in balances])
+    state.inactivity_scores = type(state.inactivity_scores)(
+        *[spec.uint64(int(x)) for x in np.asarray(dev.inactivity_scores)]
+    )
+    state.previous_epoch_participation = type(state.previous_epoch_participation)(
+        *[spec.ParticipationFlags(int(x)) for x in np.asarray(dev.prev_participation)]
+    )
+    state.current_epoch_participation = type(state.current_epoch_participation)(
+        *[spec.ParticipationFlags(int(x)) for x in np.asarray(dev.curr_participation)]
+    )
+    state.slashings = type(state.slashings)(
+        *[spec.Gwei(int(x)) for x in np.asarray(dev.slashings)]
+    )
+    mixes = np.asarray(dev.randao_mixes)
+    for i in range(mixes.shape[0]):
+        state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
+    for i, b in enumerate(np.asarray(dev.justification_bits)):
+        state.justification_bits[i] = bool(b)
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(int(dev.prev_justified_epoch)),
+        root=spec.Root(_words_to_root(dev.prev_justified_root)),
+    )
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(int(dev.curr_justified_epoch)),
+        root=spec.Root(_words_to_root(dev.curr_justified_root)),
+    )
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(int(dev.finalized_epoch)),
+        root=spec.Root(_words_to_root(dev.finalized_root)),
+    )
+
+
+def _rotate_sync_committees(spec, state) -> None:
+    """process_sync_committee_updates body, with the batched sampler."""
+    next_epoch = spec.get_current_epoch(state) + 1
+    active = np.array(
+        [int(i) for i in spec.get_active_validator_indices(state, spec.Epoch(next_epoch))],
+        dtype=np.uint64,
+    )
+    seed = spec.get_seed(state, spec.Epoch(next_epoch), spec.DOMAIN_SYNC_COMMITTEE)
+    eff = np.array([int(v.effective_balance) for v in state.validators], dtype=np.uint64)
+    indices = next_sync_committee_indices(
+        active,
+        eff,
+        bytes(seed),
+        sync_committee_size=int(spec.SYNC_COMMITTEE_SIZE),
+        max_effective_balance=int(spec.MAX_EFFECTIVE_BALANCE),
+        shuffle_round_count=int(spec.SHUFFLE_ROUND_COUNT),
+    )
+    pubkeys = [state.validators[int(i)].pubkey for i in indices]
+    state.current_sync_committee = state.next_sync_committee
+    state.next_sync_committee = spec.SyncCommittee(
+        pubkeys=pubkeys, aggregate_pubkey=spec.eth_aggregate_pubkeys(pubkeys)
+    )
+
+
+def apply_epoch_via_engine(spec, state) -> None:
+    """Mutating `process_epoch` replacement running the device engine."""
+    dev, cfg = state_to_device(spec, state)
+    dev_out, aux = epoch_fn_for(cfg)(dev)
+    _write_back(spec, state, dev_out)
+    if bool(aux.eth1_votes_reset):
+        state.eth1_data_votes = type(state.eth1_data_votes)()
+    if bool(aux.historical_append):
+        state.historical_roots.append(
+            spec.Root(
+                _words_to_root(historical_batch_root(dev_out.block_roots, dev_out.state_roots))
+            )
+        )
+    if bool(aux.sync_committee_update):
+        _rotate_sync_committees(spec, state)

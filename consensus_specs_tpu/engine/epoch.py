@@ -1,0 +1,386 @@
+"""The jitted altair `process_epoch` over a struct-of-arrays registry.
+
+One XLA program per (EpochConfig, N): every epoch sub-transition of the spec
+(specs/altair/beacon-chain.md `process_epoch`, phase0 helpers from
+specs/phase0/beacon-chain.md) re-expressed as vectorized registry sweeps:
+
+  spec function (md)                      here
+  ----------------------------------      -----------------------------------
+  process_justification_and_finalization  _justification_and_finalization
+  process_inactivity_updates              _inactivity_updates
+  process_rewards_and_penalties           _rewards_and_penalties
+  process_registry_updates                _registry_updates (sort + closed-form
+                                          exit-queue churn instead of the
+                                          sequential initiate_validator_exit)
+  process_slashings                       _slashings
+  process_eth1_data_reset                 EpochAux.eth1_votes_reset (host list)
+  process_effective_balance_updates       _effective_balance_updates
+  process_slashings_reset                 inline vector write
+  process_randao_mixes_reset              inline vector write
+  process_historical_roots_update         _historical_batch_root (device merkle)
+  process_participation_flag_updates      inline swap
+  process_sync_committee_updates          EpochAux.sync_committee_update (host,
+                                          batched: engine/sync_committee.py)
+
+Exactness: all arithmetic is uint64 (x64 mode), matching the spec's uint64
+wrap/floor-division semantics; the differential test asserts bit-equality of
+every mutated field against the compiled spec on randomized states.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.sha256_jax import merkle_parent_level, sha256_64B_words
+from .state import EpochAux, EpochConfig, EpochState
+
+U64 = jnp.uint64
+
+
+def _u(x) -> jax.Array:
+    return jnp.asarray(x, dtype=U64)
+
+
+def _isqrt_u64(x: jax.Array) -> jax.Array:
+    """Exact integer sqrt for x < 2^57 (total active balance domain).
+
+    float64 sqrt then ±1 correction; spec parity: integer_squareroot
+    (specs/phase0/beacon-chain.md, Newton iteration)."""
+    s = jnp.sqrt(x.astype(jnp.float64)).astype(U64)
+    s = jnp.where(s * s > x, s - _u(1), s)
+    s = jnp.where(s * s > x, s - _u(1), s)
+    s = jnp.where((s + _u(1)) * (s + _u(1)) <= x, s + _u(1), s)
+    return s
+
+
+def _has_flag(part: jax.Array, flag_index: int) -> jax.Array:
+    bit = jnp.uint8(1 << flag_index)
+    return (part & bit) == bit
+
+
+def _vector_root(roots: jax.Array) -> jax.Array:
+    """hash_tree_root of a Vector[Root, S] given as (S, 8) u32 words; S = 2^k."""
+    nodes = roots
+    while nodes.shape[0] > 1:
+        nodes = merkle_parent_level(nodes)
+    return nodes[0]
+
+
+@jax.jit
+def historical_batch_root(block_roots: jax.Array, state_roots: jax.Array) -> jax.Array:
+    """hash_tree_root of HistoricalBatch(block_roots, state_roots) on device.
+
+    Compiled separately from the epoch program: the append only fires once per
+    SLOTS_PER_HISTORICAL_ROOT/SLOTS_PER_EPOCH epochs (256 on mainnet), and
+    keeping the Merkle level stack out of the per-epoch jit keeps that
+    program's HLO small.
+    """
+    return sha256_64B_words(
+        jnp.concatenate([_vector_root(block_roots), _vector_root(state_roots)])[None, :]
+    )[0]
+
+
+def make_epoch_fn(cfg: EpochConfig, with_jit: bool = True):
+    """Build `process_epoch(EpochState) -> (EpochState, EpochAux)` for cfg."""
+
+    FAR = cfg.far_future_epoch
+    EBI = cfg.effective_balance_increment
+    WD = cfg.weight_denominator
+
+    def current_epoch_of(slot):
+        return slot // _u(cfg.slots_per_epoch)
+
+    def previous_epoch_of(cur):
+        return jnp.where(cur > _u(cfg.genesis_epoch), cur - _u(1), _u(cfg.genesis_epoch))
+
+    def is_active(st: EpochState, epoch):
+        return (st.activation_epoch <= epoch) & (epoch < st.exit_epoch)
+
+    def total_balance(mask, eff):
+        # spec get_total_balance: max(EFFECTIVE_BALANCE_INCREMENT, sum(...))
+        s = jnp.sum(jnp.where(mask, eff, _u(0)))
+        return jnp.maximum(s, _u(EBI))
+
+    def block_root_at_epoch(st: EpochState, epoch):
+        # get_block_root -> block_roots[start_slot % SLOTS_PER_HISTORICAL_ROOT]
+        slot = epoch * _u(cfg.slots_per_epoch)
+        return st.block_roots[(slot % _u(cfg.slots_per_historical_root)).astype(jnp.int64)]
+
+    # -- process_justification_and_finalization + weigh_... ------------------
+    def _justification_and_finalization(st: EpochState) -> EpochState:
+        cur = current_epoch_of(st.slot)
+        prev = previous_epoch_of(cur)
+        run = cur > _u(cfg.genesis_epoch + 1)
+
+        active_cur = is_active(st, cur)
+        active_prev = is_active(st, prev)
+        tab = total_balance(active_cur, st.effective_balance)
+        prev_target = total_balance(
+            active_prev & ~st.slashed & _has_flag(st.prev_participation, cfg.timely_target_flag_index),
+            st.effective_balance,
+        )
+        curr_target = total_balance(
+            active_cur & ~st.slashed & _has_flag(st.curr_participation, cfg.timely_target_flag_index),
+            st.effective_balance,
+        )
+
+        old_prev_j_epoch, old_prev_j_root = st.prev_justified_epoch, st.prev_justified_root
+        old_curr_j_epoch, old_curr_j_root = st.curr_justified_epoch, st.curr_justified_root
+
+        # bits[1:] = bits[:3]; bits[0] = 0
+        bits = jnp.concatenate([jnp.zeros((1,), bool), st.justification_bits[:3]])
+        new_j_epoch, new_j_root = old_curr_j_epoch, old_curr_j_root
+
+        prev_ok = prev_target * _u(3) >= tab * _u(2)
+        new_j_epoch = jnp.where(prev_ok, prev, new_j_epoch)
+        new_j_root = jnp.where(prev_ok, block_root_at_epoch(st, prev), new_j_root)
+        bits = bits.at[1].set(jnp.where(prev_ok, True, bits[1]))
+
+        curr_ok = curr_target * _u(3) >= tab * _u(2)
+        new_j_epoch = jnp.where(curr_ok, cur, new_j_epoch)
+        new_j_root = jnp.where(curr_ok, block_root_at_epoch(st, cur), new_j_root)
+        bits = bits.at[0].set(jnp.where(curr_ok, True, bits[0]))
+
+        fin_epoch, fin_root = st.finalized_epoch, st.finalized_root
+        rules = [
+            (bits[1] & bits[2] & bits[3], old_prev_j_epoch + _u(3) == cur, old_prev_j_epoch, old_prev_j_root),
+            (bits[1] & bits[2], old_prev_j_epoch + _u(2) == cur, old_prev_j_epoch, old_prev_j_root),
+            (bits[0] & bits[1] & bits[2], old_curr_j_epoch + _u(2) == cur, old_curr_j_epoch, old_curr_j_root),
+            (bits[0] & bits[1], old_curr_j_epoch + _u(1) == cur, old_curr_j_epoch, old_curr_j_root),
+        ]
+        for bits_ok, dist_ok, e, r in rules:
+            hit = bits_ok & dist_ok
+            fin_epoch = jnp.where(hit, e, fin_epoch)
+            fin_root = jnp.where(hit, r, fin_root)
+
+        return st.replace(
+            prev_justified_epoch=jnp.where(run, old_curr_j_epoch, st.prev_justified_epoch),
+            prev_justified_root=jnp.where(run, old_curr_j_root, st.prev_justified_root),
+            curr_justified_epoch=jnp.where(run, new_j_epoch, st.curr_justified_epoch),
+            curr_justified_root=jnp.where(run, new_j_root, st.curr_justified_root),
+            justification_bits=jnp.where(run, bits, st.justification_bits),
+            finalized_epoch=jnp.where(run, fin_epoch, st.finalized_epoch),
+            finalized_root=jnp.where(run, fin_root, st.finalized_root),
+        )
+
+    def eligible_mask(st: EpochState, prev):
+        # get_eligible_validator_indices
+        return is_active(st, prev) | (st.slashed & (prev + _u(1) < st.withdrawable_epoch))
+
+    def in_leak(st: EpochState, prev):
+        # is_in_inactivity_leak over post-J&F finalized checkpoint
+        return (prev - st.finalized_epoch) > _u(cfg.min_epochs_to_inactivity_penalty)
+
+    # -- process_inactivity_updates ------------------------------------------
+    def _inactivity_updates(st: EpochState) -> EpochState:
+        cur = current_epoch_of(st.slot)
+        prev = previous_epoch_of(cur)
+        run = cur > _u(cfg.genesis_epoch)
+
+        eligible = eligible_mask(st, prev)
+        target_part = (
+            is_active(st, prev)
+            & ~st.slashed
+            & _has_flag(st.prev_participation, cfg.timely_target_flag_index)
+        )
+        score = st.inactivity_scores
+        dec = jnp.minimum(_u(1), score)
+        score = jnp.where(eligible & target_part, score - dec, score)
+        score = jnp.where(eligible & ~target_part, score + _u(cfg.inactivity_score_bias), score)
+        recovery = jnp.minimum(_u(cfg.inactivity_score_recovery_rate), score)
+        score = jnp.where(eligible & ~in_leak(st, prev), score - recovery, score)
+        return st.replace(inactivity_scores=jnp.where(run, score, st.inactivity_scores))
+
+    # -- process_rewards_and_penalties ---------------------------------------
+    def _rewards_and_penalties(st: EpochState) -> EpochState:
+        cur = current_epoch_of(st.slot)
+        prev = previous_epoch_of(cur)
+        run = cur > _u(cfg.genesis_epoch)
+
+        active_cur = is_active(st, cur)
+        active_prev = is_active(st, prev)
+        tab = total_balance(active_cur, st.effective_balance)
+        active_increments = tab // _u(EBI)
+        brpi = _u(EBI * cfg.base_reward_factor) // _isqrt_u64(tab)  # base reward per increment
+        base_reward = (st.effective_balance // _u(EBI)) * brpi
+        eligible = eligible_mask(st, prev)
+        leak = in_leak(st, prev)
+
+        delta_sets = []
+        for flag_index, weight in enumerate(cfg.participation_flag_weights):
+            participating = (
+                active_prev & ~st.slashed & _has_flag(st.prev_participation, flag_index)
+            )
+            up_increments = total_balance(participating, st.effective_balance) // _u(EBI)
+            reward = jnp.where(
+                eligible & participating & ~leak,
+                base_reward * _u(weight) * up_increments // (active_increments * _u(WD)),
+                _u(0),
+            )
+            if flag_index != cfg.timely_head_flag_index:
+                penalty = jnp.where(
+                    eligible & ~participating,
+                    base_reward * _u(weight) // _u(WD),
+                    _u(0),
+                )
+            else:
+                penalty = jnp.zeros_like(base_reward)
+            delta_sets.append((reward, penalty))
+
+        # get_inactivity_penalty_deltas
+        target_part = (
+            active_prev & ~st.slashed & _has_flag(st.prev_participation, cfg.timely_target_flag_index)
+        )
+        inactivity_penalty = jnp.where(
+            eligible & ~target_part,
+            st.effective_balance
+            * st.inactivity_scores
+            // _u(cfg.inactivity_score_bias * cfg.inactivity_penalty_quotient),
+            _u(0),
+        )
+        delta_sets.append((jnp.zeros_like(base_reward), inactivity_penalty))
+
+        bal = st.balances
+        for reward, penalty in delta_sets:  # sequential clamp-at-zero, spec order
+            bal = bal + reward
+            bal = bal - jnp.minimum(penalty, bal)
+        return st.replace(balances=jnp.where(run, bal, st.balances))
+
+    # -- process_registry_updates --------------------------------------------
+    def _registry_updates(st: EpochState) -> EpochState:
+        n = st.balances.shape[0]
+        cur = current_epoch_of(st.slot)
+        idx = jnp.arange(n, dtype=U64)
+
+        # churn limit over pre-update active set (exit/activation epochs this
+        # loop assigns are all in the future, so the current active set — and
+        # with it get_validator_churn_limit — is invariant across iterations)
+        active_cur = is_active(st, cur)
+        churn = jnp.maximum(
+            _u(cfg.min_per_epoch_churn_limit),
+            jnp.sum(active_cur.astype(U64)) // _u(cfg.churn_limit_quotient),
+        )
+
+        # eligibility for the activation queue
+        elig_for_queue = (st.activation_eligibility_epoch == _u(FAR)) & (
+            st.effective_balance == _u(cfg.max_effective_balance)
+        )
+        activation_eligibility_epoch = jnp.where(
+            elig_for_queue, cur + _u(1), st.activation_eligibility_epoch
+        )
+
+        # ejections -> closed-form exit queue (spec: initiate_validator_exit
+        # called in index order; each call recomputes the queue frontier)
+        eject = (
+            active_cur
+            & (st.effective_balance <= _u(cfg.ejection_balance))
+            & (st.exit_epoch == _u(FAR))
+        )
+        act_exit = cur + _u(1) + _u(cfg.max_seed_lookahead)  # compute_activation_exit_epoch
+        has_exit = st.exit_epoch != _u(FAR)
+        frontier = jnp.maximum(
+            jnp.max(jnp.where(has_exit, st.exit_epoch, _u(0))), act_exit
+        )
+        frontier_count = jnp.sum((st.exit_epoch == frontier).astype(U64))
+        avail0 = jnp.where(churn > frontier_count, churn - frontier_count, _u(0))
+        qpos = jnp.cumsum(eject.astype(U64)) - _u(1)  # queue position per ejected validator
+        assigned = jnp.where(
+            qpos < avail0,
+            frontier,
+            frontier + _u(1) + jnp.where(qpos >= avail0, qpos - avail0, _u(0)) // churn,
+        )
+        exit_epoch = jnp.where(eject, assigned, st.exit_epoch)
+        withdrawable_epoch = jnp.where(
+            eject, assigned + _u(cfg.min_validator_withdrawability_delay), st.withdrawable_epoch
+        )
+
+        # activation queue: eligible sorted by (eligibility epoch, index),
+        # dequeued up to the churn limit
+        elig_act = (activation_eligibility_epoch <= st.finalized_epoch) & (
+            st.activation_epoch == _u(FAR)
+        )
+        sort_key = jnp.where(elig_act, activation_eligibility_epoch, _u(FAR))
+        order = jnp.lexsort((idx, sort_key))
+        rank = jnp.zeros(n, dtype=U64).at[order].set(idx)
+        activated = elig_act & (rank < churn)
+        activation_epoch = jnp.where(activated, act_exit, st.activation_epoch)
+
+        return st.replace(
+            activation_eligibility_epoch=activation_eligibility_epoch,
+            exit_epoch=exit_epoch,
+            withdrawable_epoch=withdrawable_epoch,
+            activation_epoch=activation_epoch,
+        )
+
+    # -- process_slashings ---------------------------------------------------
+    def _slashings(st: EpochState) -> EpochState:
+        cur = current_epoch_of(st.slot)
+        tab = total_balance(is_active(st, cur), st.effective_balance)
+        adjusted = jnp.minimum(
+            jnp.sum(st.slashings) * _u(cfg.proportional_slashing_multiplier), tab
+        )
+        hit = st.slashed & (
+            cur + _u(cfg.epochs_per_slashings_vector // 2) == st.withdrawable_epoch
+        )
+        penalty = st.effective_balance // _u(EBI) * adjusted // tab * _u(EBI)
+        penalty = jnp.where(hit, penalty, _u(0))
+        return st.replace(balances=st.balances - jnp.minimum(penalty, st.balances))
+
+    # -- process_effective_balance_updates -----------------------------------
+    def _effective_balance_updates(st: EpochState) -> EpochState:
+        hyst = EBI // cfg.hysteresis_quotient
+        down = _u(hyst * cfg.hysteresis_downward_multiplier)
+        up = _u(hyst * cfg.hysteresis_upward_multiplier)
+        bal = st.balances
+        eff = st.effective_balance
+        moved = (bal + down < eff) | (eff + up < bal)
+        new_eff = jnp.minimum(bal - bal % _u(EBI), _u(cfg.max_effective_balance))
+        return st.replace(effective_balance=jnp.where(moved, new_eff, eff))
+
+    def process_epoch(st: EpochState):
+        cur = current_epoch_of(st.slot)
+        nxt = cur + _u(1)
+
+        st = _justification_and_finalization(st)
+        st = _inactivity_updates(st)
+        st = _rewards_and_penalties(st)
+        st = _registry_updates(st)
+        st = _slashings(st)
+        st = _effective_balance_updates(st)
+
+        # process_slashings_reset
+        st = st.replace(
+            slashings=st.slashings.at[
+                (nxt % _u(cfg.epochs_per_slashings_vector)).astype(jnp.int64)
+            ].set(_u(0))
+        )
+        # process_randao_mixes_reset
+        ephv = _u(cfg.epochs_per_historical_vector)
+        st = st.replace(
+            randao_mixes=st.randao_mixes.at[(nxt % ephv).astype(jnp.int64)].set(
+                st.randao_mixes[(cur % ephv).astype(jnp.int64)]
+            )
+        )
+        # process_historical_roots_update: the host bridge calls
+        # historical_batch_root() (separately jitted) when the flag fires
+        epochs_per_batch = cfg.slots_per_historical_root // cfg.slots_per_epoch
+        aux = EpochAux(
+            historical_append=(nxt % _u(epochs_per_batch)) == _u(0),
+            eth1_votes_reset=(nxt % _u(cfg.epochs_per_eth1_voting_period)) == _u(0),
+            sync_committee_update=(nxt % _u(cfg.epochs_per_sync_committee_period)) == _u(0),
+        )
+        # process_participation_flag_updates
+        st = st.replace(
+            prev_participation=st.curr_participation,
+            curr_participation=jnp.zeros_like(st.curr_participation),
+        )
+        return st, aux
+
+    return jax.jit(process_epoch, donate_argnums=(0,)) if with_jit else process_epoch
+
+
+@lru_cache(maxsize=None)
+def epoch_fn_for(cfg: EpochConfig):
+    return make_epoch_fn(cfg)

@@ -1,0 +1,159 @@
+"""Struct-of-arrays device representation of the epoch-relevant BeaconState.
+
+The spec's `BeaconState` (specs/phase0/beacon-chain.md `class BeaconState`,
+altair overlay adds participation/inactivity/sync-committee fields) is an SSZ
+object tree: `List[Validator]` of per-validator containers. On TPU that layout
+is hostile — every epoch sub-transition is a full-registry sweep, so the device
+twin transposes it into one flat array per field (struct-of-arrays), the same
+transformation a DBMS does for a columnar scan:
+
+  spec                                  device (this module)
+  ----                                  --------------------
+  state.validators[i].effective_balance EpochState.effective_balance[i]  (N,) u64
+  state.validators[i].slashed           EpochState.slashed[i]            (N,) bool
+  state.previous_epoch_participation[i] EpochState.prev_participation[i] (N,) u8
+  ...
+
+Roots (32-byte values) are carried as (..., 8) uint32 word arrays — the native
+lane format of the batched sha256 kernel (ops/sha256_jax.py).
+
+All shapes are static per (preset, N); scalars (slot, checkpoint epochs) are
+0-d uint64 arrays so the whole struct is a jit-stable pytree. Sharding: the
+(N,) axis is the data-parallel axis — see parallel/mesh.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+from flax import struct
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochConfig:
+    """Static (compile-time) constants for one (preset x runtime-config).
+
+    Mirrors the reference split: preset yaml -> module constants, runtime
+    config -> `config` object (reference setup.py:764-788). Hashable so a
+    jitted epoch fn is cached per config.
+    """
+
+    slots_per_epoch: int
+    epochs_per_slashings_vector: int
+    epochs_per_historical_vector: int
+    slots_per_historical_root: int
+    max_effective_balance: int
+    effective_balance_increment: int
+    base_reward_factor: int
+    hysteresis_quotient: int
+    hysteresis_downward_multiplier: int
+    hysteresis_upward_multiplier: int
+    min_epochs_to_inactivity_penalty: int
+    proportional_slashing_multiplier: int
+    inactivity_penalty_quotient: int
+    max_seed_lookahead: int
+    min_seed_lookahead: int
+    epochs_per_sync_committee_period: int
+    sync_committee_size: int
+    shuffle_round_count: int
+    weight_denominator: int
+    participation_flag_weights: Tuple[int, ...]
+    timely_head_flag_index: int
+    timely_target_flag_index: int
+    inactivity_score_bias: int
+    inactivity_score_recovery_rate: int
+    min_per_epoch_churn_limit: int
+    churn_limit_quotient: int
+    ejection_balance: int
+    min_validator_withdrawability_delay: int
+    epochs_per_eth1_voting_period: int
+    genesis_epoch: int = 0
+    far_future_epoch: int = 2**64 - 1
+
+    @classmethod
+    def from_spec(cls, spec) -> "EpochConfig":
+        """Build from a compiled spec module (altair or later)."""
+        return cls(
+            slots_per_epoch=int(spec.SLOTS_PER_EPOCH),
+            epochs_per_slashings_vector=int(spec.EPOCHS_PER_SLASHINGS_VECTOR),
+            epochs_per_historical_vector=int(spec.EPOCHS_PER_HISTORICAL_VECTOR),
+            slots_per_historical_root=int(spec.SLOTS_PER_HISTORICAL_ROOT),
+            max_effective_balance=int(spec.MAX_EFFECTIVE_BALANCE),
+            effective_balance_increment=int(spec.EFFECTIVE_BALANCE_INCREMENT),
+            base_reward_factor=int(spec.BASE_REWARD_FACTOR),
+            hysteresis_quotient=int(spec.HYSTERESIS_QUOTIENT),
+            hysteresis_downward_multiplier=int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER),
+            hysteresis_upward_multiplier=int(spec.HYSTERESIS_UPWARD_MULTIPLIER),
+            min_epochs_to_inactivity_penalty=int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY),
+            proportional_slashing_multiplier=int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR),
+            inactivity_penalty_quotient=int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR),
+            max_seed_lookahead=int(spec.MAX_SEED_LOOKAHEAD),
+            min_seed_lookahead=int(spec.MIN_SEED_LOOKAHEAD),
+            epochs_per_sync_committee_period=int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD),
+            sync_committee_size=int(spec.SYNC_COMMITTEE_SIZE),
+            shuffle_round_count=int(spec.SHUFFLE_ROUND_COUNT),
+            weight_denominator=int(spec.WEIGHT_DENOMINATOR),
+            participation_flag_weights=tuple(int(w) for w in spec.PARTICIPATION_FLAG_WEIGHTS),
+            timely_head_flag_index=int(spec.TIMELY_HEAD_FLAG_INDEX),
+            timely_target_flag_index=int(spec.TIMELY_TARGET_FLAG_INDEX),
+            inactivity_score_bias=int(spec.config.INACTIVITY_SCORE_BIAS),
+            inactivity_score_recovery_rate=int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+            min_per_epoch_churn_limit=int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
+            churn_limit_quotient=int(spec.config.CHURN_LIMIT_QUOTIENT),
+            ejection_balance=int(spec.config.EJECTION_BALANCE),
+            min_validator_withdrawability_delay=int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY),
+            epochs_per_eth1_voting_period=int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD),
+            genesis_epoch=int(spec.GENESIS_EPOCH),
+        )
+
+
+@struct.dataclass
+class EpochState:
+    """Device pytree of everything `process_epoch` reads or writes."""
+
+    slot: jax.Array  # () u64
+
+    # Per-validator registry, (N,) each — the sharded axis.
+    balances: jax.Array  # u64
+    effective_balance: jax.Array  # u64
+    activation_eligibility_epoch: jax.Array  # u64
+    activation_epoch: jax.Array  # u64
+    exit_epoch: jax.Array  # u64
+    withdrawable_epoch: jax.Array  # u64
+    slashed: jax.Array  # bool
+    prev_participation: jax.Array  # u8 flag bits
+    curr_participation: jax.Array  # u8
+    inactivity_scores: jax.Array  # u64
+
+    # Small replicated vectors.
+    slashings: jax.Array  # (EPOCHS_PER_SLASHINGS_VECTOR,) u64
+    randao_mixes: jax.Array  # (EPOCHS_PER_HISTORICAL_VECTOR, 8) u32
+    block_roots: jax.Array  # (SLOTS_PER_HISTORICAL_ROOT, 8) u32
+    state_roots: jax.Array  # (SLOTS_PER_HISTORICAL_ROOT, 8) u32
+    justification_bits: jax.Array  # (4,) bool
+
+    # Checkpoints: epoch scalar + 8-word root.
+    prev_justified_epoch: jax.Array  # () u64
+    prev_justified_root: jax.Array  # (8,) u32
+    curr_justified_epoch: jax.Array  # () u64
+    curr_justified_root: jax.Array  # (8,) u32
+    finalized_epoch: jax.Array  # () u64
+    finalized_root: jax.Array  # (8,) u32
+
+    @property
+    def num_validators(self) -> int:
+        return self.balances.shape[0]
+
+
+@struct.dataclass
+class EpochAux:
+    """Side outputs of the device epoch step consumed by the host bridge."""
+
+    historical_append: jax.Array  # () bool — bridge merkleizes + appends
+    eth1_votes_reset: jax.Array  # () bool
+    sync_committee_update: jax.Array  # () bool — host recomputes committees

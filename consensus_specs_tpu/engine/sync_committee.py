@@ -1,0 +1,76 @@
+"""Batched sync-committee sampling (altair `get_next_sync_committee_indices`).
+
+The spec samples with replacement: candidate i is
+`active[shuffled(i % n)]`, accepted iff
+`effective_balance * 255 >= MAX_EFFECTIVE_BALANCE * random_byte(i)` where
+`random_byte(i) = sha256(seed || u64_le(i // 32))[i % 32]`
+(specs/altair/beacon-chain.md `get_next_sync_committee_indices`). The scalar
+loop is rejection sampling with an unbounded trip count, so it stays host-
+orchestrated — but each ingredient is batched on device: the full shuffled
+index map comes from the swap-or-not kernel (ops/shuffle.py) and candidate
+random bytes are hashed in 32-wide blocks by the batched sha256 kernel.
+
+Runs once per EPOCHS_PER_SYNC_COMMITTEE_PERIOD (256 mainnet epochs), off the
+jitted epoch hot path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sha256_jax import sha256_1block
+from ..ops.shuffle import compute_shuffled_indices, seed_to_words
+
+_CHUNK = 1024  # candidates evaluated per host round-trip
+
+
+def _candidate_random_bytes(seed: bytes, first_bucket: int, num_buckets: int) -> np.ndarray:
+    """random bytes for candidate blocks: sha256(seed || u64_le(bucket)).
+
+    Returns (num_buckets, 32) uint8 digests.
+    """
+    words = np.zeros((num_buckets, 16), dtype=np.uint32)
+    words[:, :8] = seed_to_words(seed)
+    bucket = np.arange(first_bucket, first_bucket + num_buckets, dtype=np.uint64)
+    le = bucket[:, None].view(np.uint8).reshape(num_buckets, 8).astype(np.uint32)
+    words[:, 8] = (le[:, 0] << 24) | (le[:, 1] << 16) | (le[:, 2] << 8) | le[:, 3]
+    words[:, 9] = (le[:, 4] << 24) | (le[:, 5] << 16) | (le[:, 6] << 8) | le[:, 7]
+    words[:, 10] = 0x80 << 24  # terminator after 40 message bytes
+    words[:, 15] = 320  # bit length
+    digests = np.asarray(sha256_1block(jnp.asarray(words)))  # (B, 8) u32
+    return np.ascontiguousarray(digests.astype(">u4")).view(np.uint8).reshape(num_buckets, 32)
+
+
+def next_sync_committee_indices(
+    active_indices: np.ndarray,
+    effective_balances: np.ndarray,
+    seed: bytes,
+    *,
+    sync_committee_size: int,
+    max_effective_balance: int,
+    shuffle_round_count: int,
+) -> np.ndarray:
+    """Effective-balance-weighted sample of `sync_committee_size` validator
+    indices (with replacement), bit-identical to the spec's scalar loop.
+
+    active_indices: (n,) validator indices active in the target epoch.
+    effective_balances: (N,) full-registry effective balances in Gwei.
+    """
+    n = len(active_indices)
+    assert n > 0
+    shuffled = compute_shuffled_indices(n, seed, shuffle_round_count)
+    candidates_per_cycle = shuffled  # i % n walks this map cyclically
+
+    out: list[int] = []
+    i = 0
+    while len(out) < sync_committee_size:
+        iv = np.arange(i, i + _CHUNK, dtype=np.uint64)
+        digests = _candidate_random_bytes(seed, i // 32, _CHUNK // 32 + 1)
+        random_bytes = digests[(iv // 32 - i // 32).astype(np.int64), (iv % 32).astype(np.int64)]
+        cand = active_indices[candidates_per_cycle[(iv % n).astype(np.int64)]]
+        accept = effective_balances[cand].astype(np.uint64) * 255 >= np.uint64(
+            max_effective_balance
+        ) * random_bytes.astype(np.uint64)
+        out.extend(int(c) for c in cand[accept])
+        i += _CHUNK
+    return np.array(out[:sync_committee_size], dtype=np.uint64)

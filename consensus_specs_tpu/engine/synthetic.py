@@ -1,0 +1,71 @@
+"""Synthetic `EpochState` builders for benchmarks, dry runs, and load tests.
+
+Mirrors the reference's benchmark configs (BASELINE.md: mainnet-preset
+registries from 32k to 1M validators) without paying SSZ object construction:
+arrays are generated directly in the device layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .state import EpochConfig, EpochState
+
+FAR = 2**64 - 1
+
+
+def synthetic_epoch_state(cfg: EpochConfig, n: int, seed: int = 0, epoch: int = 100) -> EpochState:
+    """A plausible mid-life registry: mostly-active validators with mixed
+    participation, some slashed, some in the exit queue, leak off."""
+    rng = np.random.default_rng(seed)
+    slot = epoch * cfg.slots_per_epoch + cfg.slots_per_epoch - 1
+    slashed = rng.random(n) < 0.01
+    exiting = rng.random(n) < 0.02
+    far = np.uint64(FAR)
+    exit_epoch = np.where(
+        exiting, (epoch + rng.integers(1, 50, n)).astype(np.uint64), far
+    )
+    withdrawable = np.where(
+        exiting, exit_epoch + np.uint64(cfg.min_validator_withdrawability_delay), far
+    )
+    withdrawable = np.where(
+        slashed,
+        (epoch + rng.integers(1, cfg.epochs_per_slashings_vector, n)).astype(np.uint64),
+        withdrawable,
+    )
+    return EpochState(
+        slot=jnp.uint64(slot),
+        balances=jnp.asarray(
+            rng.integers(31_000_000_000, 33_000_000_000, n, dtype=np.uint64)
+        ),
+        effective_balance=jnp.asarray(
+            (rng.integers(16, 33, n, dtype=np.uint64)) * cfg.effective_balance_increment
+        ),
+        activation_eligibility_epoch=jnp.zeros(n, jnp.uint64),
+        activation_epoch=jnp.zeros(n, jnp.uint64),
+        exit_epoch=jnp.asarray(exit_epoch),
+        withdrawable_epoch=jnp.asarray(withdrawable),
+        slashed=jnp.asarray(slashed),
+        prev_participation=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        curr_participation=jnp.asarray(rng.integers(0, 8, n).astype(np.uint8)),
+        inactivity_scores=jnp.asarray(rng.integers(0, 100, n, dtype=np.uint64)),
+        slashings=jnp.asarray(
+            rng.integers(0, 10_000_000_000, cfg.epochs_per_slashings_vector, dtype=np.uint64)
+        ),
+        randao_mixes=jnp.asarray(
+            rng.integers(0, 2**32, (cfg.epochs_per_historical_vector, 8), dtype=np.uint64).astype(np.uint32)
+        ),
+        block_roots=jnp.asarray(
+            rng.integers(0, 2**32, (cfg.slots_per_historical_root, 8), dtype=np.uint64).astype(np.uint32)
+        ),
+        state_roots=jnp.asarray(
+            rng.integers(0, 2**32, (cfg.slots_per_historical_root, 8), dtype=np.uint64).astype(np.uint32)
+        ),
+        justification_bits=jnp.asarray(np.array([True, True, False, False])),
+        prev_justified_epoch=jnp.uint64(epoch - 2),
+        prev_justified_root=jnp.zeros(8, jnp.uint32),
+        curr_justified_epoch=jnp.uint64(epoch - 1),
+        curr_justified_root=jnp.zeros(8, jnp.uint32),
+        finalized_epoch=jnp.uint64(epoch - 2),
+        finalized_root=jnp.zeros(8, jnp.uint32),
+    )

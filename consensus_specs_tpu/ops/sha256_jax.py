@@ -2,8 +2,12 @@
 
 Operates on uint32 *word lanes* so the whole Merkle level / shuffle round is a
 single fused XLA computation: shape (N, 16) message-word blocks in, (N, 8)
-digest words out. The 64 rounds are unrolled at trace time (constant trip
-count, no data-dependent control flow) so XLA can software-pipeline them.
+digest words out. The 64 rounds run as a `lax.fori_loop` (constant trip
+count, no data-dependent control flow): the rounds are inherently serial, the
+parallelism is across lanes, and a rolled loop keeps the HLO graph ~64x
+smaller than full unrolling — programs that instantiate many compressions
+(Merkle level stacks, the epoch engine) would otherwise take minutes to
+XLA-compile.
 
 Used by: ssz device Merkleization, the swap-or-not shuffle kernel
 (ops/shuffle.py), and randao/seed derivation inside the jitted epoch engine.
@@ -23,24 +27,37 @@ def _rotr(x, n):
 
 def _compress(state, w16):
     """state: tuple of 8 (...,) uint32; w16: (..., 16) uint32 block words."""
-    w = [w16[..., t] for t in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> jnp.uint32(3))
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> jnp.uint32(10))
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    a, b, c, d, e, f, g, h = state
-    for t in range(64):
+    k = jnp.asarray(_K)
+
+    # message schedule: (..., 64) built in-place from the 16 block words
+    w = jnp.concatenate(
+        [w16, jnp.zeros(w16.shape[:-1] + (48,), dtype=jnp.uint32)], axis=-1
+    )
+
+    def sched(t, w):
+        w15 = jax.lax.dynamic_index_in_dim(w, t - 15, axis=-1, keepdims=False)
+        w2 = jax.lax.dynamic_index_in_dim(w, t - 2, axis=-1, keepdims=False)
+        w16_ = jax.lax.dynamic_index_in_dim(w, t - 16, axis=-1, keepdims=False)
+        w7 = jax.lax.dynamic_index_in_dim(w, t - 7, axis=-1, keepdims=False)
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> jnp.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
+        return jax.lax.dynamic_update_index_in_dim(w, w16_ + s0 + w7 + s1, t, axis=-1)
+
+    w = jax.lax.fori_loop(16, 64, sched, w)
+
+    def round_fn(t, vars8):
+        a, b, c, d, e, f, g, h = vars8
+        wt = jax.lax.dynamic_index_in_dim(w, t, axis=-1, keepdims=False)
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + jnp.uint32(int(_K[t])) + w[t]
+        t1 = h + s1 + ch + k[t] + wt
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = s0 + maj
-        h, g, f = g, f, e
-        e = d + t1
-        d, c, b = c, b, a
-        a = t1 + t2
-    return tuple(s + v for s, v in zip(state, (a, b, c, d, e, f, g, h)))
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    out = jax.lax.fori_loop(0, 64, round_fn, tuple(state))
+    return tuple(s + v for s, v in zip(state, out))
 
 
 def _init_state(shape):

@@ -1,0 +1,63 @@
+"""Mesh + sharding layout for the epoch engine.
+
+The protocol's scale axis is the validator registry (SURVEY.md §2.3): every
+epoch sub-transition is an elementwise or reduce-shaped sweep over (N,)
+arrays, so the natural layout is pure data parallelism — shard the validator
+axis across the mesh, replicate the small per-epoch vectors (slashings,
+randao mixes, block roots, checkpoints). GSPMD then turns `jnp.sum` over
+sharded axes into psums over ICI and keeps everything else local.
+
+The registry sort inside process_registry_updates (activation-queue ordering)
+is the only op that needs cross-device data movement beyond reductions; XLA
+lowers it to a distributed sort.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.state import EpochState
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def epoch_state_shardings(mesh: Mesh) -> EpochState:
+    """An EpochState-shaped pytree of NamedShardings: validator axis split
+    over the mesh, everything else replicated."""
+    split = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    return EpochState(
+        slot=repl,
+        balances=split,
+        effective_balance=split,
+        activation_eligibility_epoch=split,
+        activation_epoch=split,
+        exit_epoch=split,
+        withdrawable_epoch=split,
+        slashed=split,
+        prev_participation=split,
+        curr_participation=split,
+        inactivity_scores=split,
+        slashings=repl,
+        randao_mixes=repl,
+        block_roots=repl,
+        state_roots=repl,
+        justification_bits=repl,
+        prev_justified_epoch=repl,
+        prev_justified_root=repl,
+        curr_justified_epoch=repl,
+        curr_justified_root=repl,
+        finalized_epoch=repl,
+        finalized_root=repl,
+    )
+
+
+def shard_epoch_state(state: EpochState, mesh: Mesh) -> EpochState:
+    """Place an EpochState onto the mesh with the standard layout."""
+    return jax.device_put(state, epoch_state_shardings(mesh))

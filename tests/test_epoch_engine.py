@@ -1,0 +1,166 @@
+"""Differential tests: device epoch engine vs the compiled altair spec.
+
+The jitted struct-of-arrays `process_epoch` (engine/epoch.py) must agree
+bit-for-bit with the executable spec's scalar `process_epoch` on every mutated
+field — checked here via SSZ hash_tree_root equality of whole post-states on
+randomized registries (balance spreads, slashed validators, exit queues,
+participation flags, inactivity scores, leak and non-leak finality).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.engine import apply_epoch_via_engine
+from consensus_specs_tpu.engine.sync_committee import next_sync_committee_indices
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_epoch, transition_to
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    bls.bls_active = False
+    yield
+    bls.bls_active = True
+
+
+def randomize_state(spec, state, rng: random.Random, leak: bool = False) -> None:
+    n = len(state.validators)
+    for i in range(n):
+        v = state.validators[i]
+        state.balances[i] = spec.Gwei(rng.randrange(0, 40_000_000_000))
+        if rng.random() < 0.2:
+            v.effective_balance = spec.Gwei(
+                rng.randrange(0, 33) * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            )
+        if rng.random() < 0.1:
+            v.slashed = True
+            v.withdrawable_epoch = spec.Epoch(
+                spec.get_current_epoch(state) + rng.randrange(0, 80)
+            )
+        if rng.random() < 0.1:
+            v.exit_epoch = spec.Epoch(spec.get_current_epoch(state) + rng.randrange(1, 20))
+        if rng.random() < 0.1:
+            v.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+            v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        state.inactivity_scores[i] = spec.uint64(rng.randrange(0, 200))
+        state.previous_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.current_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+    for i in range(len(state.slashings)):
+        state.slashings[i] = spec.Gwei(rng.randrange(0, 64_000_000_000))
+    if not leak:
+        # keep finality close so is_in_inactivity_leak is False
+        cur = spec.get_current_epoch(state)
+        fin = max(0, int(cur) - 2)
+        state.finalized_checkpoint = spec.Checkpoint(
+            epoch=spec.Epoch(fin), root=state.finalized_checkpoint.root
+        )
+
+
+def run_both(spec, state):
+    ref = state.copy()
+    eng = state.copy()
+    spec.process_epoch(ref)
+    apply_epoch_via_engine(spec, eng)
+    assert spec.hash_tree_root(eng) == spec.hash_tree_root(ref)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_epoch_engine_random(spec, seed):
+    rng = random.Random(seed)
+    state = create_valid_beacon_state(spec, num_validators=64)
+    # get past genesis gating and the first sync-committee period boundary
+    for _ in range(3 + seed):
+        next_epoch(spec, state)
+    randomize_state(spec, state, rng)
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH - 1)
+    run_both(spec, state)
+
+
+def test_epoch_engine_genesis_epoch(spec):
+    state = create_valid_beacon_state(spec, num_validators=32)
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH - 1)
+    run_both(spec, state)
+
+
+def test_epoch_engine_inactivity_leak(spec):
+    rng = random.Random(7)
+    state = create_valid_beacon_state(spec, num_validators=64)
+    for _ in range(8):
+        next_epoch(spec, state)
+    randomize_state(spec, state, rng, leak=True)
+    # ancient finality => leak
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(0), root=state.finalized_checkpoint.root
+    )
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH - 1)
+    run_both(spec, state)
+
+
+def test_epoch_engine_full_participation_justifies(spec):
+    state = create_valid_beacon_state(spec, num_validators=64)
+    for _ in range(3):
+        next_epoch(spec, state)
+    flags = spec.ParticipationFlags(0b111)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = flags
+        state.current_epoch_participation[i] = flags
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH - 1)
+    run_both(spec, state)
+
+
+def test_epoch_engine_activation_queue_churn(spec):
+    """More eligible-for-activation validators than the churn limit."""
+    rng = random.Random(11)
+    state = create_valid_beacon_state(spec, num_validators=64)
+    for _ in range(4):
+        next_epoch(spec, state)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(spec.get_current_epoch(state) - 1),
+        root=state.finalized_checkpoint.root,
+    )
+    for i in range(0, 40):
+        v = state.validators[i]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = spec.Epoch(rng.randrange(0, 3))
+    # also force ejections beyond churn
+    for i in range(40, 60):
+        state.validators[i].effective_balance = spec.Gwei(
+            int(spec.config.EJECTION_BALANCE) // 2
+        )
+    transition_to(spec, state, state.slot + spec.SLOTS_PER_EPOCH - 1)
+    run_both(spec, state)
+
+
+def test_sync_committee_sampler_matches_spec(spec):
+    state = create_valid_beacon_state(spec, num_validators=64)
+    rng = random.Random(3)
+    for i in range(len(state.validators)):
+        if rng.random() < 0.3:
+            state.validators[i].effective_balance = spec.Gwei(
+                rng.randrange(1, 33) * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            )
+    want = [int(i) for i in spec.get_next_sync_committee_indices(state)]
+    next_ep = spec.get_current_epoch(state) + 1
+    active = np.array(
+        [int(i) for i in spec.get_active_validator_indices(state, spec.Epoch(next_ep))],
+        dtype=np.uint64,
+    )
+    seed = spec.get_seed(state, spec.Epoch(next_ep), spec.DOMAIN_SYNC_COMMITTEE)
+    eff = np.array([int(v.effective_balance) for v in state.validators], dtype=np.uint64)
+    got = next_sync_committee_indices(
+        active,
+        eff,
+        bytes(seed),
+        sync_committee_size=int(spec.SYNC_COMMITTEE_SIZE),
+        max_effective_balance=int(spec.MAX_EFFECTIVE_BALANCE),
+        shuffle_round_count=int(spec.SHUFFLE_ROUND_COUNT),
+    )
+    assert [int(x) for x in got] == want
