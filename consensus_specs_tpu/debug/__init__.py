@@ -1,0 +1,13 @@
+"""Debug / introspection codecs (reference layer L8).
+
+SSZ objects <-> plain YAML-safe python structures (for test vectors), plus a
+random SSZ object fuzzer used by the ssz_static vector generator.
+
+Reference parity: tests/core/pyspec/eth2spec/debug/{encode.py,decode.py,
+random_value.py}.
+"""
+from .encode import encode
+from .decode import decode
+from .random_value import RandomizationMode, get_random_ssz_object
+
+__all__ = ["encode", "decode", "RandomizationMode", "get_random_ssz_object"]

@@ -1,0 +1,39 @@
+"""YAML-safe python structure -> SSZ object (inverse of debug/encode.py).
+
+Reference parity: tests/core/pyspec/eth2spec/debug/decode.py.
+"""
+from __future__ import annotations
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+def decode(data, typ):
+    if issubclass(typ, boolean):
+        return typ(data)
+    if issubclass(typ, uint):
+        return typ(int(data))
+    if issubclass(typ, (ByteVector, ByteList)):
+        return typ(bytes.fromhex(data[2:]))
+    if issubclass(typ, (Bitvector, Bitlist)):
+        return typ.decode_bytes(bytes.fromhex(data[2:]))
+    if issubclass(typ, (Vector, List)):
+        return typ(*[decode(e, typ.ELEM_TYPE) for e in data])
+    if issubclass(typ, Container):
+        return typ(**{name: decode(data[name], ft) for name, ft in typ.fields().items()})
+    if issubclass(typ, Union):
+        sel = int(data["selector"])
+        opt = typ.OPTIONS[sel]
+        val = None if opt is None else decode(data["value"], opt)
+        return typ(selector=sel, value=val)
+    raise TypeError(f"cannot decode into {typ.__name__}")

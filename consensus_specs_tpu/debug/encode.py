@@ -1,0 +1,40 @@
+"""SSZ object -> YAML-safe python structure.
+
+Format compatibility with the reference vector corpus is a conformance
+requirement (tests/core/pyspec/eth2spec/debug/encode.py): uints wider than
+32 bits become decimal strings (YAML 1.1 int readers lose precision beyond
+2^53), byte blobs and packed bitfields become 0x-hex strings, containers
+become dicts keyed by field name.
+"""
+from __future__ import annotations
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+def encode(value):
+    if isinstance(value, boolean):
+        return bool(value)
+    if isinstance(value, uint):
+        return int(value) if value.BYTE_LEN <= 4 else str(int(value))
+    if isinstance(value, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (Bitvector, Bitlist)):
+        return "0x" + value.encode_bytes().hex()
+    if isinstance(value, (Vector, List)):
+        return [encode(e) for e in value]
+    if isinstance(value, Container):
+        return {name: encode(getattr(value, name)) for name in value.fields()}
+    if isinstance(value, Union):
+        return {"selector": value.selector, "value": None if value.value is None else encode(value.value)}
+    raise TypeError(f"cannot encode {type(value).__name__}")

@@ -1,0 +1,168 @@
+"""Random SSZ object fuzzer for the ssz_static vector generator.
+
+Reference parity: tests/core/pyspec/eth2spec/debug/random_value.py — six
+randomization modes plus a chaos switch:
+
+  random     fully random values, random list/bytelist lengths
+  zero       all-zero values, empty lists
+  max        all-max values (0xff bytes, max uints), empty lists
+  nil        lists empty, everything else random
+  one        lists of length 1, everything else random
+  lengthy    lists at their max sampled length, everything else random
+
+chaos=True re-rolls the mode per sub-object, producing mixed shapes.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from random import Random
+
+from ..ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+class RandomizationMode(Enum):
+    mode_random = 0
+    mode_zero = 1
+    mode_max = 2
+    mode_nil_count = 3
+    mode_one_count = 4
+    mode_max_count = 5
+
+    def is_changing(self) -> bool:
+        """Modes that vary element values (not the all-zero / all-max fills)."""
+        return self in (
+            RandomizationMode.mode_random,
+            RandomizationMode.mode_nil_count,
+            RandomizationMode.mode_one_count,
+            RandomizationMode.mode_max_count,
+        )
+
+
+def get_random_ssz_object(
+    rng: Random,
+    typ,
+    max_bytes_length: int,
+    max_list_length: int,
+    mode: RandomizationMode,
+    chaos: bool = False,
+):
+    if chaos:
+        mode = rng.choice(list(RandomizationMode))
+
+    if issubclass(typ, boolean):
+        if mode == RandomizationMode.mode_zero:
+            return typ(False)
+        if mode == RandomizationMode.mode_max:
+            return typ(True)
+        return typ(rng.choice((True, False)))
+
+    if issubclass(typ, uint):
+        if mode == RandomizationMode.mode_zero:
+            return typ(0)
+        if mode == RandomizationMode.mode_max:
+            return typ(2 ** (typ.BYTE_LEN * 8) - 1)
+        return typ(rng.randint(0, 2 ** (typ.BYTE_LEN * 8) - 1))
+
+    if issubclass(typ, ByteVector):
+        if mode == RandomizationMode.mode_zero:
+            return typ(b"\x00" * typ.LENGTH)
+        if mode == RandomizationMode.mode_max:
+            return typ(b"\xff" * typ.LENGTH)
+        return typ(rng.randbytes(typ.LENGTH))
+
+    if issubclass(typ, ByteList):
+        length = min(typ.LIMIT, max_bytes_length)
+        if mode in (RandomizationMode.mode_zero, RandomizationMode.mode_max, RandomizationMode.mode_nil_count):
+            n = 0
+        elif mode == RandomizationMode.mode_one_count:
+            n = min(1, length)
+        elif mode == RandomizationMode.mode_max_count:
+            n = length
+        else:
+            n = rng.randint(0, length)
+        fill = b"\x00" if mode == RandomizationMode.mode_zero else b"\xff"
+        if mode in (RandomizationMode.mode_zero, RandomizationMode.mode_max):
+            return typ(fill * n)
+        return typ(rng.randbytes(n))
+
+    if issubclass(typ, Bitvector):
+        if mode == RandomizationMode.mode_zero:
+            return typ([False] * typ.LENGTH)
+        if mode == RandomizationMode.mode_max:
+            return typ([True] * typ.LENGTH)
+        return typ([rng.choice((True, False)) for _ in range(typ.LENGTH)])
+
+    if issubclass(typ, Bitlist):
+        length = min(typ.LIMIT, max_list_length)
+        if mode in (RandomizationMode.mode_zero, RandomizationMode.mode_max, RandomizationMode.mode_nil_count):
+            n = 0
+        elif mode == RandomizationMode.mode_one_count:
+            n = min(1, length)
+        elif mode == RandomizationMode.mode_max_count:
+            n = length
+        else:
+            n = rng.randint(0, length)
+        if mode == RandomizationMode.mode_max:
+            return typ([True] * n)
+        return typ([rng.choice((True, False)) for _ in range(n)])
+
+    if issubclass(typ, Vector):
+        return typ(
+            *[
+                get_random_ssz_object(rng, typ.ELEM_TYPE, max_bytes_length, max_list_length, mode, chaos)
+                for _ in range(typ.LENGTH)
+            ]
+        )
+
+    if issubclass(typ, List):
+        length = min(typ.LIMIT, max_list_length)
+        if mode in (RandomizationMode.mode_zero, RandomizationMode.mode_nil_count):
+            n = 0
+        elif mode == RandomizationMode.mode_one_count:
+            n = min(1, length)
+        elif mode in (RandomizationMode.mode_max, RandomizationMode.mode_max_count):
+            n = length
+        else:
+            n = rng.randint(0, length)
+        return typ(
+            *[
+                get_random_ssz_object(rng, typ.ELEM_TYPE, max_bytes_length, max_list_length, mode, chaos)
+                for _ in range(n)
+            ]
+        )
+
+    if issubclass(typ, Container):
+        return typ(
+            **{
+                name: get_random_ssz_object(rng, ftyp, max_bytes_length, max_list_length, mode, chaos)
+                for name, ftyp in typ.fields().items()
+            }
+        )
+
+    if issubclass(typ, Union):
+        if mode == RandomizationMode.mode_zero:
+            selector = 0
+        elif mode == RandomizationMode.mode_max:
+            selector = len(typ.OPTIONS) - 1
+        else:
+            selector = rng.randrange(len(typ.OPTIONS))
+        opt = typ.OPTIONS[selector]
+        value = (
+            None
+            if opt is None
+            else get_random_ssz_object(rng, opt, max_bytes_length, max_list_length, mode, chaos)
+        )
+        return typ(selector, value)
+
+    raise TypeError(f"cannot generate random {typ.__name__}")

@@ -1,0 +1,17 @@
+"""Test-vector generator runtime (reference layer L7).
+
+Reference parity: tests/core/pyspec/eth2spec/gen_helpers/ — gen_base
+(run_generator, TestCase/TestProvider) and gen_from_tests (reflection bridge
+from dual-mode test modules to vector output).
+"""
+from .gen_typing import TestCase, TestProvider
+from .gen_runner import run_generator
+from .gen_from_tests import generate_from_tests, run_state_test_generators
+
+__all__ = [
+    "TestCase",
+    "TestProvider",
+    "run_generator",
+    "generate_from_tests",
+    "run_state_test_generators",
+]

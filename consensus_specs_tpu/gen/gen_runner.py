@@ -1,0 +1,119 @@
+"""Vector-generator CLI runtime.
+
+Reference parity: gen_helpers/gen_base/gen_runner.py (run_generator :41-218,
+dump_yaml_fn :221, dump_ssz_fn :229): walks TestProviders, writes each case
+under <preset>/<fork>/<runner>/<handler>/<suite>/<case>/, YAML for data/meta
+parts, snappy-compressed SSZ for binary parts, an INCOMPLETE sentinel during
+writing for crash forensics, an error log, skip-existing incremental mode,
+and a slow-case timing print.
+
+Output tree and file conventions match the consensus-spec-tests format
+(reference tests/formats/README.md) so external clients can consume vectors
+from either framework interchangeably.
+"""
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+import time
+import traceback
+from pathlib import Path
+
+import yaml
+
+from ..native import snappy
+from ..ssz import SSZType, serialize
+from .gen_typing import TestCase, TestProvider
+
+TIME_THRESHOLD_TO_PRINT = 1.0  # seconds
+
+
+def _dump_yaml(path: Path, name: str, data) -> None:
+    with open(path / f"{name}.yaml", "w") as f:
+        yaml.safe_dump(data, f, default_flow_style=None)
+
+
+def _dump_ssz(path: Path, name: str, value) -> None:
+    raw = serialize(value) if isinstance(value, SSZType) else bytes(value)
+    with open(path / f"{name}.ssz_snappy", "wb") as f:
+        f.write(snappy.compress(raw))
+
+
+def _write_case(case: TestCase, case_dir: Path, log: list[str]) -> bool:
+    """Returns True if the case produced output (False => skipped/empty)."""
+    parts = case.case_fn()
+    if parts is None:
+        return False
+    case_dir.mkdir(parents=True, exist_ok=True)
+    incomplete = case_dir / "INCOMPLETE"
+    incomplete.touch()
+    meta: dict = {}
+    for name, kind, value in parts:
+        if kind == "meta":
+            meta[name] = value
+        elif kind == "ssz":
+            _dump_ssz(case_dir, name, value)
+        elif kind == "data":
+            _dump_yaml(case_dir, name, value)
+        else:
+            raise ValueError(f"unknown part kind {kind!r} for part {name!r}")
+    if meta:
+        _dump_yaml(case_dir, "meta", meta)
+    incomplete.unlink()
+    return True
+
+
+def run_generator(generator_name: str, providers: list[TestProvider], args=None) -> int:
+    parser = argparse.ArgumentParser(prog=f"gen-{generator_name}")
+    parser.add_argument("-o", "--output-dir", required=True)
+    parser.add_argument("-f", "--force", action="store_true", help="regenerate existing cases")
+    parser.add_argument("--preset-list", nargs="*", default=None)
+    parser.add_argument("--fork-list", nargs="*", default=None)
+    ns = parser.parse_args(args)
+
+    output_dir = Path(ns.output_dir)
+    log: list[str] = []
+    generated = skipped = failed = 0
+
+    for provider in providers:
+        provider.prepare()
+        for case in provider.make_cases():
+            if ns.preset_list and case.preset_name not in ns.preset_list:
+                continue
+            if ns.fork_list and case.fork_name not in ns.fork_list:
+                continue
+            case_dir = output_dir / "tests" / case.path
+            if case_dir.exists():
+                if not ns.force and not (case_dir / "INCOMPLETE").exists():
+                    skipped += 1
+                    continue
+                shutil.rmtree(case_dir)
+            t0 = time.time()
+            try:
+                if _write_case(case, case_dir, log):
+                    generated += 1
+                else:
+                    skipped += 1
+            except Exception:
+                failed += 1
+                err = f"[ERROR] {case.path}:\n{traceback.format_exc()}"
+                log.append(err)
+                print(err, file=sys.stderr)
+            elapsed = time.time() - t0
+            if elapsed > TIME_THRESHOLD_TO_PRINT:
+                print(f"[slow] {case.path}: {elapsed:.1f}s")
+
+    if log:
+        output_dir.mkdir(parents=True, exist_ok=True)
+        with open(output_dir / "testgen_error_log.txt", "a") as f:
+            f.write("\n".join(log) + "\n")
+    print(
+        f"{generator_name}: generated {generated}, skipped {skipped}, failed {failed}"
+    )
+    return 1 if failed else 0
+
+
+def detect_incomplete(output_dir: str) -> list[str]:
+    """Paths of cases whose INCOMPLETE sentinel survived (crash forensics)."""
+    return [str(p.parent) for p in Path(output_dir).rglob("INCOMPLETE")]

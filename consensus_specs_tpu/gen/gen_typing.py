@@ -1,0 +1,44 @@
+"""Typed shapes of the vector-generator pipeline.
+
+Reference parity: gen_helpers/gen_base/gen_typing.py (TestCase :20,
+TestProvider :31). A case's `case_fn` returns the typed parts list produced
+by the dual-mode context engine: [(name, kind, value)] with kind in
+{"meta", "data", "ssz"}.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, List, Optional, Tuple
+
+
+@dataclass
+class TestCase:
+    fork_name: str
+    preset_name: str
+    runner_name: str
+    handler_name: str
+    suite_name: str
+    case_name: str
+    case_fn: Callable[[], Optional[List[Tuple[str, str, object]]]]
+    dir_meta: dict = field(default_factory=dict)
+
+    @property
+    def path(self) -> str:
+        return "/".join(
+            (
+                self.preset_name,
+                self.fork_name,
+                self.runner_name,
+                self.handler_name,
+                self.suite_name,
+                self.case_name,
+            )
+        )
+
+
+@dataclass
+class TestProvider:
+    """prepare() runs once (e.g. switch BLS backend); make_cases yields cases."""
+
+    make_cases: Callable[[], Iterable[TestCase]]
+    prepare: Callable[[], None] = lambda: None

@@ -1,0 +1,9 @@
+"""Native (C++) components of the framework runtime.
+
+The reference hides its native code behind pip wheels (milagro BLS, snappy,
+pycryptodome — SURVEY.md §2.2); here the native layer is an in-repo build:
+C++ sources compiled once into shared libraries and loaded via ctypes, with
+pure-Python fallbacks so the framework degrades gracefully without a
+toolchain.
+"""
+from .snappy import compress, decompress  # noqa: F401

@@ -1,0 +1,223 @@
+// Snappy block-format codec (compress + decompress), C++ native component.
+//
+// Role: the reference emits test vectors as `.ssz_snappy` via the
+// python-snappy C binding (reference gen_helpers/gen_base/gen_runner.py
+// dump_ssz_fn; setup.py python-snappy==0.5.4). That binding is not in this
+// image, and format compatibility with consensus-spec-tests is a conformance
+// requirement, so the codec is implemented here from the public format
+// description (google/snappy format_description.txt):
+//
+//   stream   := uncompressed-length-varint element*
+//   element  := literal | copy1 | copy2 | copy4
+//   literal  : tag&3==0, len-1 in tag>>2 (<=59), 60..63 => 1..4 extra
+//              little-endian length bytes holding len-1
+//   copy1    : tag&3==1, len = 4 + ((tag>>2)&7) in 4..11,
+//              offset = ((tag>>5)<<8) | next byte   (11-bit)
+//   copy2    : tag&3==2, len = (tag>>2)+1 in 1..64, offset = next 2 bytes LE
+//   copy4    : tag&3==3, len = (tag>>2)+1, offset = next 4 bytes LE
+//
+// Compressor: greedy hash-table matcher over 64 KiB fragments (offsets stay
+// <= 65535 so copy2 always suffices), the standard snappy strategy. Any
+// spec-conforming decompressor (client test harnesses) can read the output.
+//
+// Build: consensus_specs_tpu/native/build.py (g++ -O2 -shared -fPIC);
+// loaded via ctypes in consensus_specs_tpu/native/snappy.py with a pure-
+// Python fallback implementing the identical format.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr size_t kFragmentSize = 1 << 16;  // 64 KiB
+constexpr int kHashBits = 14;
+constexpr size_t kHashSize = 1 << kHashBits;
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash32(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> (32 - kHashBits);
+}
+
+// Emit a literal run [p, p+len) into out; returns bytes written.
+size_t emit_literal(const uint8_t* p, size_t len, uint8_t* out) {
+  uint8_t* o = out;
+  if (len == 0) return 0;
+  size_t n = len - 1;
+  if (n < 60) {
+    *o++ = static_cast<uint8_t>(n << 2);
+  } else if (n < (1u << 8)) {
+    *o++ = 60 << 2;
+    *o++ = static_cast<uint8_t>(n);
+  } else if (n < (1u << 16)) {
+    *o++ = 61 << 2;
+    *o++ = static_cast<uint8_t>(n);
+    *o++ = static_cast<uint8_t>(n >> 8);
+  } else if (n < (1u << 24)) {
+    *o++ = 62 << 2;
+    *o++ = static_cast<uint8_t>(n);
+    *o++ = static_cast<uint8_t>(n >> 8);
+    *o++ = static_cast<uint8_t>(n >> 16);
+  } else {
+    *o++ = 63 << 2;
+    *o++ = static_cast<uint8_t>(n);
+    *o++ = static_cast<uint8_t>(n >> 8);
+    *o++ = static_cast<uint8_t>(n >> 16);
+    *o++ = static_cast<uint8_t>(n >> 24);
+  }
+  std::memcpy(o, p, len);
+  return static_cast<size_t>(o - out) + len;
+}
+
+// Emit copies covering `len` bytes at `offset` (<= 65535); returns bytes written.
+size_t emit_copy(size_t offset, size_t len, uint8_t* out) {
+  uint8_t* o = out;
+  // Long matches: chop into <=64-byte copy2 elements, keeping the tail >= 4.
+  while (len >= 68) {
+    *o++ = static_cast<uint8_t>(((64 - 1) << 2) | 2);
+    *o++ = static_cast<uint8_t>(offset);
+    *o++ = static_cast<uint8_t>(offset >> 8);
+    len -= 64;
+  }
+  if (len > 64) {
+    *o++ = static_cast<uint8_t>(((60 - 1) << 2) | 2);
+    *o++ = static_cast<uint8_t>(offset);
+    *o++ = static_cast<uint8_t>(offset >> 8);
+    len -= 60;
+  }
+  if (len >= 12 || offset >= 2048 || len < 4) {
+    *o++ = static_cast<uint8_t>(((len - 1) << 2) | 2);
+    *o++ = static_cast<uint8_t>(offset);
+    *o++ = static_cast<uint8_t>(offset >> 8);
+  } else {
+    // copy1: len 4..11, offset < 2048
+    *o++ = static_cast<uint8_t>(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+    *o++ = static_cast<uint8_t>(offset);
+  }
+  return static_cast<size_t>(o - out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Worst case: varint (5) + per-fragment literal overhead.
+size_t snappy_tpu_max_compressed_length(size_t n) {
+  return 32 + n + n / 6;
+}
+
+long snappy_tpu_compress(const uint8_t* in, size_t n, uint8_t* out) {
+  uint8_t* o = out;
+  // uncompressed length varint
+  size_t v = n;
+  while (v >= 0x80) {
+    *o++ = static_cast<uint8_t>(v) | 0x80;
+    v >>= 7;
+  }
+  *o++ = static_cast<uint8_t>(v);
+
+  static thread_local uint16_t table[kHashSize];
+  for (size_t frag = 0; frag < n || (n == 0 && frag == 0); frag += kFragmentSize) {
+    size_t frag_len = n - frag < kFragmentSize ? n - frag : kFragmentSize;
+    if (frag_len == 0) break;
+    const uint8_t* base = in + frag;
+    std::memset(table, 0, sizeof(table));
+    size_t ip = 0;
+    size_t lit_start = 0;
+    if (frag_len >= 15) {
+      size_t ip_limit = frag_len - 4;
+      while (ip <= ip_limit) {
+        uint32_t cur = load32(base + ip);
+        uint32_t h = hash32(cur);
+        size_t cand = table[h];
+        table[h] = static_cast<uint16_t>(ip);
+        if (cand < ip && load32(base + cand) == cur) {
+          // extend match
+          size_t m = 4;
+          while (ip + m < frag_len && base[cand + m] == base[ip + m]) m++;
+          o += emit_literal(base + lit_start, ip - lit_start, o);
+          o += emit_copy(ip - cand, m, o);
+          ip += m;
+          lit_start = ip;
+        } else {
+          ip++;
+        }
+      }
+    }
+    o += emit_literal(base + lit_start, frag_len - lit_start, o);
+  }
+  return static_cast<long>(o - out);
+}
+
+long snappy_tpu_uncompressed_length(const uint8_t* in, size_t n) {
+  size_t result = 0;
+  int shift = 0;
+  for (size_t i = 0; i < n && i < 10; i++) {
+    result |= static_cast<size_t>(in[i] & 0x7f) << shift;
+    if (!(in[i] & 0x80)) return static_cast<long>(result);
+    shift += 7;
+  }
+  return -1;
+}
+
+long snappy_tpu_decompress(const uint8_t* in, size_t n, uint8_t* out, size_t out_cap) {
+  size_t ip = 0;
+  // skip varint
+  while (ip < n && (in[ip] & 0x80)) ip++;
+  if (ip >= n) return -1;
+  ip++;
+
+  size_t op = 0;
+  while (ip < n) {
+    uint8_t tag = in[ip++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      size_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        size_t extra = len - 60;
+        if (ip + extra > n) return -1;
+        len = 0;
+        for (size_t i = 0; i < extra; i++) len |= static_cast<size_t>(in[ip + i]) << (8 * i);
+        len += 1;
+        ip += extra;
+      }
+      if (ip + len > n || op + len > out_cap) return -1;
+      std::memcpy(out + op, in + ip, len);
+      ip += len;
+      op += len;
+    } else {
+      size_t len, offset;
+      if (kind == 1) {
+        len = 4 + ((tag >> 2) & 7);
+        if (ip >= n) return -1;
+        offset = (static_cast<size_t>(tag >> 5) << 8) | in[ip++];
+      } else if (kind == 2) {
+        len = (tag >> 2) + 1;
+        if (ip + 2 > n) return -1;
+        offset = in[ip] | (static_cast<size_t>(in[ip + 1]) << 8);
+        ip += 2;
+      } else {
+        len = (tag >> 2) + 1;
+        if (ip + 4 > n) return -1;
+        offset = in[ip] | (static_cast<size_t>(in[ip + 1]) << 8) |
+                 (static_cast<size_t>(in[ip + 2]) << 16) |
+                 (static_cast<size_t>(in[ip + 3]) << 24);
+        ip += 4;
+      }
+      if (offset == 0 || offset > op || op + len > out_cap) return -1;
+      // byte-by-byte: copies may overlap forward (RLE-style)
+      for (size_t i = 0; i < len; i++) {
+        out[op] = out[op - offset];
+        op++;
+      }
+    }
+  }
+  return static_cast<long>(op);
+}
+
+}  // extern "C"

@@ -1,0 +1,178 @@
+"""Snappy block-format codec: ctypes binding over the C++ core, with a
+pure-Python fallback implementing the identical format.
+
+Used for `.ssz_snappy` test-vector files (reference: python-snappy in
+gen_helpers/gen_base/gen_runner.py dump_ssz_fn) — format compatibility with
+the consensus-spec-tests corpus is a conformance requirement.
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "snappy.cpp"
+_LIB = _HERE / "_snappy.so"
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(str(_LIB))
+            lib.snappy_tpu_max_compressed_length.restype = ctypes.c_size_t
+            lib.snappy_tpu_max_compressed_length.argtypes = [ctypes.c_size_t]
+            lib.snappy_tpu_compress.restype = ctypes.c_long
+            lib.snappy_tpu_compress.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+            lib.snappy_tpu_decompress.restype = ctypes.c_long
+            lib.snappy_tpu_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.snappy_tpu_uncompressed_length.restype = ctypes.c_long
+            lib.snappy_tpu_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_compress(data)
+    out = ctypes.create_string_buffer(lib.snappy_tpu_max_compressed_length(len(data)))
+    n = lib.snappy_tpu_compress(data, len(data), out)
+    if n < 0:
+        raise RuntimeError("snappy compress failed")
+    return out.raw[:n]
+
+
+def decompress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_decompress(data)
+    size = lib.snappy_tpu_uncompressed_length(data, len(data))
+    if size < 0:
+        raise ValueError("snappy: bad length preamble")
+    out = ctypes.create_string_buffer(max(size, 1))
+    n = lib.snappy_tpu_decompress(data, len(data), out, size)
+    if n != size:
+        raise ValueError("snappy: corrupt stream")
+    return out.raw[:size]
+
+
+# --- pure-Python fallback (identical stream format) ------------------------
+
+def _emit_varint(n: int) -> bytes:
+    out = bytearray()
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    return bytes(out)
+
+
+def _emit_literal(data: bytes) -> bytes:
+    n = len(data) - 1
+    if n < 60:
+        return bytes([n << 2]) + data
+    extra = (n.bit_length() + 7) // 8
+    return bytes([(59 + extra) << 2]) + n.to_bytes(extra, "little") + data
+
+
+def _py_compress(data: bytes) -> bytes:
+    out = bytearray(_emit_varint(len(data)))
+    for frag in range(0, len(data) or 1, 1 << 16):
+        block = data[frag : frag + (1 << 16)]
+        if not block:
+            break
+        table: dict[bytes, int] = {}
+        ip = lit = 0
+        limit = len(block) - 4
+        while ip <= limit:
+            key = block[ip : ip + 4]
+            cand = table.get(key)
+            table[key] = ip
+            if cand is not None:
+                m = 4
+                while ip + m < len(block) and block[cand + m] == block[ip + m]:
+                    m += 1
+                if ip > lit:
+                    out += _emit_literal(block[lit:ip])
+                off = ip - cand
+                rem = m
+                while rem >= 68:
+                    out += bytes([(63 << 2) | 2, off & 0xFF, off >> 8])
+                    rem -= 64
+                if rem > 64:
+                    out += bytes([(59 << 2) | 2, off & 0xFF, off >> 8])
+                    rem -= 60
+                out += bytes([((rem - 1) << 2) | 2, off & 0xFF, off >> 8])
+                ip += m
+                lit = ip
+            else:
+                ip += 1
+        if len(block) > lit:
+            out += _emit_literal(block[lit:])
+    return bytes(out)
+
+
+def _py_decompress(data: bytes) -> bytes:
+    ip = 0
+    size = shift = 0
+    while True:
+        b = data[ip]
+        ip += 1
+        size |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while ip < len(data):
+        tag = data[ip]
+        ip += 1
+        kind = tag & 3
+        if kind == 0:
+            n = tag >> 2
+            if n >= 60:
+                extra = n - 59
+                n = int.from_bytes(data[ip : ip + extra], "little")
+                ip += extra
+            n += 1
+            out += data[ip : ip + n]
+            ip += n
+        else:
+            if kind == 1:
+                n = 4 + ((tag >> 2) & 7)
+                off = ((tag >> 5) << 8) | data[ip]
+                ip += 1
+            elif kind == 2:
+                n = (tag >> 2) + 1
+                off = int.from_bytes(data[ip : ip + 2], "little")
+                ip += 2
+            else:
+                n = (tag >> 2) + 1
+                off = int.from_bytes(data[ip : ip + 4], "little")
+                ip += 4
+            if off == 0 or off > len(out):
+                raise ValueError("snappy: bad copy offset")
+            for _ in range(n):
+                out.append(out[-off])
+    if len(out) != size:
+        raise ValueError("snappy: corrupt stream")
+    return bytes(out)

@@ -1,0 +1,9 @@
+"""Dual-mode conformance test modules.
+
+Each module holds decorator-driven test bodies (testlib/context.py) that run
+both as pytest assertions (collected via tests/test_spec_suite.py) and as
+test-vector emitters (via consensus_specs_tpu/gen + generators/).
+
+Reference parity: tests/core/pyspec/eth2spec/test/{fork}/ test trees — the
+same single-body/two-modes architecture (SURVEY.md §4).
+"""

@@ -1,0 +1,122 @@
+"""Dual-mode epoch-processing conformance tests.
+
+Vector format (reference tests/formats/epoch_processing/README.md):
+pre.ssz_snappy + post.ssz_snappy around exactly one epoch sub-transition.
+
+Reference parity targets: test/phase0/epoch_processing/ and
+test/altair/epoch_processing/ (effective balance hysteresis, justification,
+registry churn, slashing penalties, participation resets).
+"""
+from ..testlib.context import (
+    ALTAIR,
+    BELLATRIX,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from ..testlib.epoch_processing import run_epoch_processing_with
+from ..testlib.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    # run up to (not including) the hysteresis update, with crafted balances
+    inc = spec.EFFECTIVE_BALANCE_INCREMENT
+    max_bal = spec.MAX_EFFECTIVE_BALANCE
+    half_inc = inc // 2
+    # thresholds: down = inc/4, up = 5*inc/4 (HYSTERESIS_QUOTIENT=4, mults 1/5)
+    cases = [
+        (max_bal, max_bal, max_bal, "as-is"),
+        (max_bal, max_bal - 1, max_bal, "lower but within hysteresis"),
+        (max_bal, max_bal + 1, max_bal, "higher but within hysteresis"),
+        (max_bal, max_bal - inc, max_bal - inc, "past downward threshold"),
+        (max_bal - inc, max_bal, max_bal - inc, "above but within hysteresis"),
+        (max_bal - inc, max_bal + half_inc, max_bal, "past upward threshold"),
+        (max_bal - inc, max_bal + inc * 2, max_bal, "past upward threshold, capped"),
+    ]
+    for i, (pre_eff, bal, _, _) in enumerate(cases):
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+
+    yield from run_epoch_processing_with(spec, state, "process_effective_balance_updates")
+
+    for i, (_, _, post_eff, name) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, name
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset_no_votes(spec, state):
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_reset(spec, state):
+    for i in range(len(state.slashings)):
+        state.slashings[i] = spec.Gwei(1_000_000_000)
+    yield from run_epoch_processing_with(spec, state, "process_slashings_reset")
+    next_epoch_slot = (spec.get_current_epoch(state) + 1) % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    assert state.slashings[next_epoch_slot] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_activation_queue(spec, state):
+    for _ in range(3):
+        next_epoch(spec, state)
+    # two fresh validators, eligible as of the finalized epoch
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.get_current_epoch(state) - 1, root=state.finalized_checkpoint.root
+    )
+    for i in (0, 1):
+        v = state.validators[i]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = spec.Epoch(1)
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    expected = spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+    for i in (0, 1):
+        assert state.validators[i].activation_epoch == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_ejection(spec, state):
+    next_epoch(spec, state)
+    idx = 0
+    state.validators[idx].effective_balance = spec.config.EJECTION_BALANCE
+    assert spec.is_active_validator(state.validators[idx], spec.get_current_epoch(state))
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    assert state.validators[idx].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_participation_flag_updates_rotation(spec, state):
+    full = spec.ParticipationFlags(0b111)
+    for i in range(len(state.validators)):
+        state.current_epoch_participation[i] = full
+    yield from run_epoch_processing_with(spec, state, "process_participation_flag_updates")
+    assert all(int(f) == 0b111 for f in state.previous_epoch_participation)
+    assert all(int(f) == 0 for f in state.current_epoch_participation)
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_inactivity_scores_recovery(spec, state):
+    # everyone participating, scores decay by the recovery rate
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    target_flag = spec.ParticipationFlags(2**spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = spec.uint64(20)
+        state.previous_epoch_participation[i] = target_flag
+    # recent finality => not leaking
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.get_current_epoch(state) - 1, root=state.finalized_checkpoint.root
+    )
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    expected = 20 - 1 - min(20 - 1, int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE))
+    assert all(int(s) == expected for s in state.inactivity_scores)

@@ -1,0 +1,118 @@
+"""Dual-mode block-operation conformance tests.
+
+Vector format (reference tests/formats/operations/README.md): pre.ssz_snappy,
+<operation>.ssz_snappy, post.ssz_snappy — post absent when the operation must
+be rejected.
+
+Reference parity targets: test/phase0/block_processing/test_process_attestation.py,
+test_process_voluntary_exit.py (success + representative invalid cases).
+"""
+from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from ..testlib.state import next_epoch, next_slots, transition_to
+
+
+def _run_op(spec, state, name, operation, valid=True):
+    yield "pre", state.copy()
+    yield name, operation
+    process = getattr(spec, f"process_{name}")
+    if not valid:
+        expect_assertion_error(lambda: process(state, operation))
+        return
+    process(state, operation)
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_success(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # state.slot == attestation.data.slot: inclusion delay not yet met
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_after_epoch_window(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attestation_invalid_signature(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.signature = spec.BLSSignature(b"\x01" + b"\x00" * 95)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_wrong_index(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # out-of-range committee index: the spec must reject before any lookup
+    attestation.data.index += 1000
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+def _build_voluntary_exit(spec, state, index):
+    from ..crypto import bls
+    from ..testlib.keys import privkeys
+
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=index
+    )
+    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    signing_root = spec.compute_signing_root(exit_msg, domain)
+    return spec.SignedVoluntaryExit(
+        message=exit_msg, signature=bls.Sign(privkeys[index], signing_root)
+    )
+
+
+def _age_state_past_shard_committee_period(spec, state):
+    epochs = int(spec.config.SHARD_COMMITTEE_PERIOD)
+    slot = state.slot + epochs * spec.SLOTS_PER_EPOCH
+    spec.process_slots(state, slot)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_success(spec, state):
+    _age_state_past_shard_committee_period(spec, state)
+    signed_exit = _build_voluntary_exit(spec, state, 0)
+    yield from _run_op(spec, state, "voluntary_exit", signed_exit)
+    assert state.validators[0].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_validator_too_young(spec, state):
+    # validator has not been active for SHARD_COMMITTEE_PERIOD epochs
+    signed_exit = _build_voluntary_exit(spec, state, 0)
+    yield from _run_op(spec, state, "voluntary_exit", signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_double_exit(spec, state):
+    _age_state_past_shard_committee_period(spec, state)
+    signed_exit = _build_voluntary_exit(spec, state, 0)
+    spec.process_voluntary_exit(state, signed_exit)
+    yield from _run_op(spec, state, "voluntary_exit", signed_exit, valid=False)

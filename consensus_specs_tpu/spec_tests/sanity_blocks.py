@@ -1,0 +1,74 @@
+"""Dual-mode sanity tests: whole-block / whole-slot transitions.
+
+Vector format (reference tests/formats/sanity/README.md): pre.ssz_snappy,
+blocks_<i>.ssz_snappy, post.ssz_snappy (absent when the transition must
+reject), meta.yaml {blocks_count}.
+
+Reference parity targets: test/phase0/sanity/test_blocks.py,
+test_slots.py (empty block, skipped slots, proposer slashings path).
+"""
+from ..testlib.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.state import next_epoch, next_slots
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_1(spec, state):
+    yield "pre", state.copy()
+    spec.process_slots(state, state.slot + 1)
+    yield "slots", "data", 1
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_double_empty_epoch(spec, state):
+    yield "pre", state.copy()
+    spec.process_slots(state, state.slot + 2 * spec.SLOTS_PER_EPOCH)
+    yield "slots", "data", 2 * int(spec.SLOTS_PER_EPOCH)
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    pre_slot = state.slot
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert state.slot == pre_slot + 1
+    assert state.latest_block_header.parent_root == block.parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    yield "pre", state.copy()
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert state.slot == block.slot
+
+
+@with_all_phases
+@spec_state_test
+def test_two_empty_blocks(spec, state):
+    yield "pre", state.copy()
+    signed = []
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", "data", 2
+    for i, s in enumerate(signed):
+        yield f"blocks_{i}", s
+    yield "post", state.copy()

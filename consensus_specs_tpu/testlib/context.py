@@ -1,0 +1,300 @@
+"""Decorator context engine: one test body, a (fork x preset x BLS) matrix,
+two execution modes.
+
+Reference parity: tests/core/pyspec/eth2spec/test/context.py (spec_targets
+:67-78, with_custom_state + genesis LRU cache :96-116, spec_test :249,
+spec_state_test :259, never_bls/always_bls/bls_switch :285-325, with_phases
+:422, with_presets :450, with_config_overrides :493-525) and
+test/utils/utils.py vector_test (:6-73) — the central dual-mode design: a
+test body is a generator yielding named parts; under pytest the parts are
+drained and assertions do the testing; under generator mode the identical run
+is serialized into client-consumable vectors.
+
+Usage:
+
+    @with_all_phases
+    @spec_state_test
+    def test_something(spec, state):
+        yield "pre", state
+        ... mutate ...
+        yield "post", state
+
+Outermost wrapper signature (what pytest and the vector generator both call):
+
+    test_something(preset=None, fork=None, generator_mode=False, bls_active=None)
+
+Under pytest (no args) it runs every selected fork on the default preset.
+Under generator mode the runner pins one (fork, preset) and collects the
+typed parts list.
+"""
+from __future__ import annotations
+
+import functools
+from random import Random
+
+from ..compiler import get_spec
+from ..crypto import bls
+from ..ssz import SSZType, serialize
+from .genesis import create_genesis_state
+
+# Fork / preset universe (mirrors compiler FORK_ORDER; sharding-era forks are
+# spec'd but not compiled, same as the reference's build targets).
+PHASE0 = "phase0"
+ALTAIR = "altair"
+BELLATRIX = "bellatrix"
+ALL_PHASES = (PHASE0, ALTAIR, BELLATRIX)
+MINIMAL = "minimal"
+MAINNET = "mainnet"
+DEFAULT_TEST_PRESET = MINIMAL
+
+
+# --- part collection (vector_test dual-mode) --------------------------------
+
+def _normalize_part(item):
+    """yielded item -> (name, kind, value); kinds: meta | data | ssz."""
+    if len(item) == 3:
+        name, kind, value = item
+        return name, kind, value
+    name, value = item
+    if isinstance(value, SSZType):
+        return name, "ssz", value
+    return name, "data", value
+
+
+def vector_test(fn):
+    """Make a yielding test body dual-mode (reference vector_test)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, generator_mode=False, **kwargs):
+        out = fn(*args, **kwargs)
+        if out is None:
+            return None
+        parts = []
+        for item in out:
+            if item is None:
+                continue
+            parts.append(_normalize_part(item))
+        return parts if generator_mode else None
+
+    return wrapper
+
+
+# --- genesis-state cache ----------------------------------------------------
+
+_state_cache: dict = {}
+
+
+def default_balances(spec):
+    n = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    return [int(spec.MAX_EFFECTIVE_BALANCE)] * n
+
+
+def low_balances(spec):
+    n = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    return [int(spec.config.EJECTION_BALANCE)] * n
+
+
+def misc_balances(spec):
+    n = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    mx = int(spec.MAX_EFFECTIVE_BALANCE)
+    balances = [mx * 2 * i // n for i in range(n)]
+    Random(3141).shuffle(balances)
+    return balances
+
+
+def _cached_genesis(spec, balances_fn, threshold_fn):
+    key = (spec.fork, spec.preset_name, balances_fn.__name__, threshold_fn.__name__)
+    if key not in _state_cache:
+        balances = balances_fn(spec)
+        threshold = threshold_fn(spec)
+        _state_cache[key] = create_genesis_state(spec, balances, threshold)
+    return _state_cache[key].copy()
+
+
+def _default_threshold(spec):
+    return spec.MAX_EFFECTIVE_BALANCE
+
+
+def _low_threshold(spec):
+    return spec.config.EJECTION_BALANCE
+
+
+# --- core decorators --------------------------------------------------------
+
+def spec_test(fn):
+    """Innermost: dual-mode part collection (no state fixture)."""
+    return vector_test(fn)
+
+
+def with_custom_state(balances_fn, threshold_fn):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec, **kwargs):
+            state = _cached_genesis(spec, balances_fn, threshold_fn)
+            return fn(*args, spec=spec, state=state, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def spec_state_test(fn):
+    """spec_test + default genesis state fixture."""
+    return spec_test(with_custom_state(default_balances, _default_threshold)(_kwargs_body(fn)))
+
+
+def _kwargs_body(fn):
+    """Adapt positional body(spec, state) to keyword calling convention."""
+
+    @functools.wraps(fn)
+    def wrapper(*, spec, state=None, **kwargs):
+        if state is None:
+            return fn(spec, **kwargs)
+        return fn(spec, state, **kwargs)
+
+    return wrapper
+
+
+def spec_configured_state_test(balances_fn=default_balances, threshold_fn=_default_threshold):
+    def deco(fn):
+        return spec_test(with_custom_state(balances_fn, threshold_fn)(_kwargs_body(fn)))
+
+    return deco
+
+
+# --- BLS switches -----------------------------------------------------------
+
+def _with_bls(fn, active, meta_tag):
+    @functools.wraps(fn)
+    def wrapper(*args, bls_active=None, generator_mode=False, **kwargs):
+        want = active if active is not None else (
+            bls_active if bls_active is not None else bls.bls_active
+        )
+        prev = bls.bls_active
+        bls.bls_active = want
+        try:
+            parts = fn(*args, generator_mode=generator_mode, **kwargs)
+        finally:
+            bls.bls_active = prev
+        if generator_mode and parts is not None and meta_tag is not None:
+            parts = [("bls_setting", "meta", meta_tag)] + parts
+        return parts
+
+    return wrapper
+
+
+def always_bls(fn):
+    """Test is meaningless without real signature checks (meta bls_setting=1)."""
+    return _with_bls(fn, True, 1)
+
+
+def never_bls(fn):
+    """Test must run with BLS off (meta bls_setting=2)."""
+    return _with_bls(fn, False, 2)
+
+
+def bls_switch(fn):
+    """Honor the caller's bls_active flag (pytest default: off, for speed)."""
+    return _with_bls(fn, None, None)
+
+
+# --- fork / preset matrix ---------------------------------------------------
+
+def with_phases(phases, other_phases=None):
+    """Outermost: expand over forks; pytest runs all, generator pins one."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(preset=None, fork=None, generator_mode=False, bls_active=None, **kwargs):
+            preset = preset or DEFAULT_TEST_PRESET
+            run_forks = [fork] if fork else list(phases)
+            results = {}
+            prev_bls = bls.bls_active
+            if bls_active is not None:
+                # ambient default; an inner always_bls/never_bls still overrides
+                bls.bls_active = bls_active
+            try:
+                for f in run_forks:
+                    if f not in phases and (other_phases is None or f not in other_phases):
+                        continue
+                    spec = get_spec(f, preset)
+                    extra = {}
+                    if other_phases:
+                        extra["phases"] = {
+                            g: get_spec(g, preset) for g in (*phases, *other_phases)
+                        }
+                    results[f] = fn(
+                        spec=spec, generator_mode=generator_mode, **extra, **kwargs
+                    )
+            finally:
+                bls.bls_active = prev_bls
+            # pytest (no pinned fork) must see None; the generator pins a fork
+            # and receives that fork's typed parts
+            return results[fork] if fork else None
+
+        wrapper.run_phases = tuple(phases)
+        wrapper.all_phases = tuple(phases) + tuple(other_phases or ())
+        # pytest resolves fixtures from the *original* body signature via
+        # __wrapped__; hide it so the zero-arg wrapper is what gets collected
+        del wrapper.__wrapped__
+        return wrapper
+
+    return deco
+
+
+def with_all_phases(fn):
+    return with_phases(ALL_PHASES)(fn)
+
+
+def with_all_phases_except(excluded):
+    return with_phases([p for p in ALL_PHASES if p not in excluded])
+
+
+def with_presets(presets, reason=None):
+    """Restrict a test to given presets (e.g. minimal-only scenario sizes)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(preset=None, **kwargs):
+            preset = preset or DEFAULT_TEST_PRESET
+            if preset not in presets:
+                return None  # skipped
+            return fn(preset=preset, **kwargs)
+
+        wrapper.allowed_presets = tuple(presets)
+        return wrapper
+
+    return deco
+
+
+def with_config_overrides(overrides: dict):
+    """Run with a modified runtime config (fresh spec module per overrides)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec, **kwargs):
+            from ..compiler.spec_compiler import build_spec
+
+            patched = build_spec(spec.fork, spec.preset_name, config_overrides=overrides)
+            return fn(*args, spec=patched, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# --- misc helpers -----------------------------------------------------------
+
+def expect_assertion_error(fn):
+    """Run fn expecting the spec to reject (AssertionError or IndexError —
+    reference counts out-of-range accesses as failed asserts, context.py
+    :271-282)."""
+    try:
+        fn()
+    except (AssertionError, IndexError):
+        return
+    raise AssertionError("expected the spec to reject, but it accepted")
+
+
+def serialize_part(value):
+    return serialize(value)

@@ -1,0 +1,63 @@
+"""Epoch-processing test harness helpers.
+
+Reference parity: test/helpers/epoch_processing.py (run_epoch_processing_to
+:36-55): advance the state to the final slot of the epoch, then run the
+epoch sub-transitions *in spec order* up to — but not including — the target,
+so a test can exercise exactly one sub-transition against a realistic
+pre-state.
+"""
+from __future__ import annotations
+
+
+def get_process_calls(spec) -> list[str]:
+    """Sub-transition order of the fork's process_epoch. Fork-aware by name
+    (the overlay namespace keeps superseded phase0 functions importable, so
+    hasattr alone would leak process_participation_record_updates into
+    altair's order)."""
+    if spec.fork == "phase0":
+        return [
+            "process_justification_and_finalization",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_record_updates",
+        ]
+    return [
+        "process_justification_and_finalization",
+        "process_inactivity_updates",
+        "process_rewards_and_penalties",
+        "process_registry_updates",
+        "process_slashings",
+        "process_eth1_data_reset",
+        "process_effective_balance_updates",
+        "process_slashings_reset",
+        "process_randao_mixes_reset",
+        "process_historical_roots_update",
+        "process_participation_flag_updates",
+        "process_sync_committee_updates",
+    ]
+
+
+def run_epoch_processing_to(spec, state, process_name: str) -> None:
+    """Process slots to the epoch boundary, then sub-transitions before
+    `process_name`."""
+    slot = state.slot + (spec.SLOTS_PER_EPOCH - state.slot % spec.SLOTS_PER_EPOCH) - 1
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+    for name in get_process_calls(spec):
+        if name == process_name:
+            break
+        getattr(spec, name)(state)
+
+
+def run_epoch_processing_with(spec, state, process_name: str):
+    """Dual-mode runner: yields pre, runs the sub-transition, yields post."""
+    run_epoch_processing_to(spec, state, process_name)
+    yield "pre", state.copy()
+    getattr(spec, process_name)(state)
+    yield "post", state.copy()

@@ -1,0 +1,21 @@
+"""JAX platform selection guard for host-side tools.
+
+The deployment environment pins JAX_PLATFORMS to a remote-TPU plugin that is
+only registered when its site hook ran at interpreter start. Generator CLIs
+and other host tools must work in both worlds: use the pinned platform when
+it is actually available, otherwise fall back to CPU instead of dying with
+"Backend 'axon' is not in the list of known backends".
+"""
+from __future__ import annotations
+
+
+def ensure_usable_jax_backend() -> str:
+    """Returns the selected backend name, downgrading to cpu if the pinned
+    platform is unavailable in this process."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend()

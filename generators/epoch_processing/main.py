@@ -1,0 +1,18 @@
+"""Epoch-processing vector generator.
+
+Reference parity: tests/generators/epoch_processing/main.py — maps fork ->
+dual-mode test modules and runs them through the generator runtime.
+Usage: python main.py -o <output_dir> [--preset-list minimal]
+"""
+from consensus_specs_tpu.gen import run_state_test_generators
+
+from consensus_specs_tpu.spec_tests import epoch_processing as ep
+
+ALL_MODS = {
+    "phase0": {"epoch_processing": ep},
+    "altair": {"epoch_processing": ep},
+    "bellatrix": {"epoch_processing": ep},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("epoch_processing", ALL_MODS, presets=("minimal",))

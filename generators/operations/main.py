@@ -1,0 +1,17 @@
+"""Block-operation vector generator.
+
+Reference parity: tests/generators/operations/main.py.
+Usage: python main.py -o <output_dir> [--preset-list minimal]
+"""
+from consensus_specs_tpu.gen import run_state_test_generators
+
+from consensus_specs_tpu.spec_tests import operations as ops
+
+ALL_MODS = {
+    "phase0": {"operations": ops},
+    "altair": {"operations": ops},
+    "bellatrix": {"operations": ops},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("operations", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,17 @@
+"""Sanity (blocks/slots) vector generator.
+
+Reference parity: tests/generators/sanity/main.py.
+Usage: python main.py -o <output_dir> [--preset-list minimal]
+"""
+from consensus_specs_tpu.gen import run_state_test_generators
+
+from consensus_specs_tpu.spec_tests import sanity_blocks
+
+ALL_MODS = {
+    "phase0": {"blocks": sanity_blocks},
+    "altair": {"blocks": sanity_blocks},
+    "bellatrix": {"blocks": sanity_blocks},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("sanity", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,52 @@
+"""Shuffling vector generator: full swap-or-not permutation maps.
+
+Reference parity: tests/generators/shuffling/main.py + tests/formats/shuffling
+— per seed and count, a mapping.yaml {seed, count, mapping} that clients
+replay against their shuffle implementation. The mapping comes from the
+batched device kernel (ops/shuffle.py), which the test suite has already
+differentially validated against the scalar spec.
+"""
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.gen import TestCase, TestProvider
+from consensus_specs_tpu.gen.gen_runner import run_generator
+from consensus_specs_tpu.ops.shuffle import compute_shuffled_indices
+from consensus_specs_tpu.utils.platform import ensure_usable_jax_backend
+
+
+def make_cases():
+    for preset in ("minimal", "mainnet"):
+        spec = get_spec("phase0", preset)
+        rounds = int(spec.SHUFFLE_ROUND_COUNT)
+        for seed_i in range(16):
+            seed = spec.hash(seed_i.to_bytes(4, "little"))
+            for count in (1, 2, 3, 5, 8, 16, 21, 64, 256, 512, 1000):
+                name = f"shuffle_0x{bytes(seed).hex()[:18]}_{count}"
+
+                def case_fn(seed=seed, count=count, rounds=rounds):
+                    mapping = compute_shuffled_indices(count, bytes(seed), rounds)
+                    return [
+                        (
+                            "mapping",
+                            "data",
+                            {
+                                "seed": "0x" + bytes(seed).hex(),
+                                "count": count,
+                                "mapping": [int(x) for x in mapping],
+                            },
+                        )
+                    ]
+
+                yield TestCase(
+                    fork_name="phase0",
+                    preset_name=preset,
+                    runner_name="shuffling",
+                    handler_name="core",
+                    suite_name="shuffle",
+                    case_name=name,
+                    case_fn=case_fn,
+                )
+
+
+if __name__ == "__main__":
+    ensure_usable_jax_backend()
+    raise SystemExit(run_generator("shuffling", [TestProvider(make_cases=make_cases)]))

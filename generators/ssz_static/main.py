@@ -1,0 +1,63 @@
+"""ssz_static vector generator: random roundtrips of every spec container.
+
+Reference parity: tests/generators/ssz_static/main.py + tests/formats/
+ssz_static — for each SSZ container in each compiled fork, emit randomized
+instances as {roots.yaml (hash_tree_root), serialized.ssz_snappy,
+value.yaml (debug encoding)} across the randomization modes of
+debug/random_value.py.
+"""
+from random import Random
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.debug import RandomizationMode, encode, get_random_ssz_object
+from consensus_specs_tpu.gen import TestCase, TestProvider
+from consensus_specs_tpu.gen.gen_runner import run_generator
+from consensus_specs_tpu.ssz import Container, hash_tree_root
+
+MAX_BYTES_LENGTH = 1000
+MAX_LIST_LENGTH = 10
+
+
+def ssz_container_types(spec):
+    out = {}
+    for name, obj in vars(spec).items():
+        if isinstance(obj, type) and issubclass(obj, Container) and obj is not Container:
+            out[name] = obj
+    return out
+
+
+def make_cases():
+    for preset in ("minimal",):
+        for fork in ("phase0", "altair", "bellatrix"):
+            spec = get_spec(fork, preset)
+            for type_name, typ in sorted(ssz_container_types(spec).items()):
+                for mode in RandomizationMode:
+                    for chaos in (False, True) if mode == RandomizationMode.mode_random else (False,):
+                        count = 3 if mode == RandomizationMode.mode_random else 1
+                        for i in range(count):
+                            seed = hash((fork, type_name, mode.value, chaos, i)) & 0xFFFFFFFF
+
+                            def case_fn(typ=typ, mode=mode, chaos=chaos, seed=seed):
+                                value = get_random_ssz_object(
+                                    Random(seed), typ, MAX_BYTES_LENGTH, MAX_LIST_LENGTH, mode, chaos
+                                )
+                                return [
+                                    ("roots", "data", {"root": "0x" + bytes(hash_tree_root(value)).hex()}),
+                                    ("serialized", "ssz", value),
+                                    ("value", "data", encode(value)),
+                                ]
+
+                            suffix = f"{mode.name}{'_chaos' if chaos else ''}_{i}"
+                            yield TestCase(
+                                fork_name=fork,
+                                preset_name=preset,
+                                runner_name="ssz_static",
+                                handler_name=type_name,
+                                suite_name="ssz_random",
+                                case_name=f"case_{suffix}",
+                                case_fn=case_fn,
+                            )
+
+
+if __name__ == "__main__":
+    raise SystemExit(run_generator("ssz_static", [TestProvider(make_cases=make_cases)]))

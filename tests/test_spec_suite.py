@@ -1,0 +1,23 @@
+"""Collect the dual-mode conformance suite under pytest.
+
+Each imported name is a decorator-wrapped test body (testlib/context.py) that
+pytest calls with no arguments: it then runs every selected fork on the
+minimal preset with BLS stubs (fast mode), mirroring the reference's default
+`make test` configuration (minimal + --disable-bls).
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+
+
+@pytest.fixture(autouse=True)
+def _fast_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+from consensus_specs_tpu.spec_tests.epoch_processing import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.operations import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.sanity_blocks import *  # noqa: E402,F401,F403
