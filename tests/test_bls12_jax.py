@@ -1,0 +1,147 @@
+"""Differential tests: batched BLS12-381 tower/curve/pairing kernels vs the
+pure-Python oracle (crypto/bls12_381.py)."""
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as oracle
+from consensus_specs_tpu.ops import bls12_jax as K
+from consensus_specs_tpu.ops import fp_jax as F
+
+rng = random.Random(99)
+
+
+def rand_f2():
+    return (rng.randrange(F.P), rng.randrange(F.P))
+
+
+def f2_dev(x):
+    return K.f2_to_device(x)
+
+
+def f2_host(x):
+    return (
+        F.from_mont_int(np.asarray(x[0]).reshape(-1, F.NLIMBS)[0]),
+        F.from_mont_int(np.asarray(x[1]).reshape(-1, F.NLIMBS)[0]),
+    )
+
+
+F2_SAMPLES = [rand_f2() for _ in range(6)] + [(0, 0), (1, 0), (0, 1)]
+
+
+@pytest.mark.parametrize("op", ["add", "sub", "mul", "sqr", "inv", "xi"])
+def test_f2_ops(op):
+    for a in F2_SAMPLES:
+        b = rand_f2()
+        da, db = f2_dev(a), f2_dev(b)
+        if op == "add":
+            got, want = f2_host(K.f2_add(da, db)), oracle.f2_add(a, b)
+        elif op == "sub":
+            got, want = f2_host(K.f2_sub(da, db)), oracle.f2_sub(a, b)
+        elif op == "mul":
+            got, want = f2_host(K.f2_mul(da, db)), oracle.f2_mul(a, b)
+        elif op == "sqr":
+            got, want = f2_host(K.f2_sqr(da)), oracle.f2_sqr(a)
+        elif op == "xi":
+            got, want = f2_host(K.f2_mul_xi(da)), oracle.f2_mul(a, oracle.XI)
+        else:
+            if a == (0, 0):
+                continue
+            got, want = f2_host(K.f2_inv(da)), oracle.f2_inv(a)
+        assert got == want, (op, a, b)
+
+
+def rand_f12():
+    return tuple(rand_f2() for _ in range(6))
+
+
+def f12_dev(x):
+    return tuple(f2_dev(c) for c in x)
+
+
+F12_SAMPLES = [rand_f12() for _ in range(3)]
+
+
+def test_f12_mul_sqr_inv_conj():
+    for a in F12_SAMPLES:
+        b = rand_f12()
+        da, db = f12_dev(a), f12_dev(b)
+        assert K.f12_from_device(K.f12_mul(da, db)) == oracle.f12_mul(a, b)
+        assert K.f12_from_device(K.f12_sqr(da)) == oracle.f12_sqr(a)
+        assert K.f12_from_device(K.f12_conj(da)) == oracle.f12_conj(a)
+        assert K.f12_from_device(K.f12_inv(da)) == oracle.f12_inv(a)
+
+
+def test_f12_frobenius():
+    for a in F12_SAMPLES:
+        da = f12_dev(a)
+        assert K.f12_from_device(K.f12_frobenius(da)) == oracle.f12_frobenius(a, 1)
+        assert K.f12_from_device(K.f12_frobenius2(da)) == oracle.f12_frobenius(a, 2)
+
+
+def _pairing_inputs(k1: int, k2: int):
+    """scalar multiples of the generators, in affine int coords."""
+    p1 = oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, k1))
+    q1 = oracle.pt_to_affine(oracle.FP2_FIELD, oracle.pt_mul(oracle.FP2_FIELD, oracle.G2_GEN, k2))
+    return p1, q1
+
+
+def test_pairing_matches_oracle():
+    # the device final exp computes the CUBE of the canonical pairing
+    p1, q1 = _pairing_inputs(5, 7)
+    want = oracle.f12_pow(oracle.pairing(q1, p1), 3)
+    qx, qy = K.f2_to_device(q1[0]), K.f2_to_device(q1[1])
+    px, py = K.fp_to_device(p1[0]), K.fp_to_device(p1[1])
+    got = K.f12_from_device(
+        K.pairing_cube_batch((qx[0], qx[1]), (qy[0], qy[1]), px, py)
+    )
+    assert got == want
+
+
+def test_pairing_check_bilinear():
+    # e([a]G1, G2) · e(-G1, [a]G2) == 1
+    a = 11
+    pa, _ = _pairing_inputs(a, 1)
+    g1 = oracle.G1_GEN_AFF
+    _, qa = _pairing_inputs(1, a)
+    g2 = oracle.G2_GEN_AFF
+    neg_g1 = (g1[0], (-g1[1]) % F.P)
+
+    def dev_f2pair(q):
+        x, y = K.f2_to_device(q[0]), K.f2_to_device(q[1])
+        return (x[0], x[1]), (y[0], y[1])
+
+    qx1, qy1 = dev_f2pair(g2)
+    qx2, qy2 = dev_f2pair(qa)
+    ok = K.pairing_check_batch(
+        qx1, qy1, K.fp_to_device(pa[0]), K.fp_to_device(pa[1]),
+        qx2, qy2, K.fp_to_device(neg_g1[0]), K.fp_to_device(neg_g1[1]),
+    )
+    assert bool(ok)
+
+    # and a wrong pair fails
+    bad = K.pairing_check_batch(
+        qx1, qy1, K.fp_to_device(pa[0]), K.fp_to_device(pa[1]),
+        qx2, qy2, K.fp_to_device(g1[0]), K.fp_to_device(g1[1]),
+    )
+    assert not bool(bad)
+
+
+def test_g1_add_reduce():
+    pts = [
+        oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, k))
+        for k in (1, 2, 3, 10)
+    ]
+    want = oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, 16))
+    X = jnp.stack([K.fp_to_device(p[0]) for p in pts])
+    Y = jnp.stack([K.fp_to_device(p[1]) for p in pts])
+    Z = jnp.stack([jnp.asarray(F.ONE_MONT)] * len(pts))
+    s = K.g1_sum_reduce((X, Y, Z))
+    ax, ay = K.g1_to_affine(s)
+    got = (
+        F.from_mont_int(np.asarray(ax)),
+        F.from_mont_int(np.asarray(ay)),
+    )
+    assert got == want

@@ -1,0 +1,80 @@
+"""Differential tests: batched Fp kernels vs Python bignum arithmetic."""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops import fp_jax as F
+
+rng = random.Random(1234)
+SAMPLES = [0, 1, 2, F.P - 1, F.P - 2, (1 << 380) % F.P] + [
+    rng.randrange(F.P) for _ in range(26)
+]
+
+
+def mont(xs):
+    return np.asarray(F.ints_to_mont_batch(xs))
+
+
+def unmont(arr):
+    return F.mont_batch_to_ints(arr)
+
+
+def test_limb_codec_roundtrip():
+    for x in SAMPLES:
+        assert F.limbs_to_int(F.int_to_limbs(x)) == x
+        assert F.from_mont_int(F.to_mont(x)) == x
+
+
+def test_add_sub_neg():
+    a = SAMPLES
+    b = list(reversed(SAMPLES))
+    am, bm = mont(a), mont(b)
+    got_add = unmont(F.fp_add(am, bm))
+    got_sub = unmont(F.fp_sub(am, bm))
+    got_neg = unmont(F.fp_neg(am))
+    for x, y, ga, gs, gn in zip(a, b, got_add, got_sub, got_neg):
+        assert ga == (x + y) % F.P
+        assert gs == (x - y) % F.P
+        assert gn == (-x) % F.P
+
+
+def test_mont_mul():
+    a = SAMPLES
+    b = list(reversed(SAMPLES))
+    got = unmont(F.fp_mont_mul(mont(a), mont(b)))
+    for x, y, g in zip(a, b, got):
+        assert g == (x * y) % F.P
+
+
+def test_mont_sqr_chain():
+    # repeated squaring stays exact over many iterations (carry soundness)
+    x = SAMPLES[-1]
+    am = mont([x])
+    expect = x
+    for _ in range(50):
+        am = F.fp_mont_sqr(am)
+        expect = (expect * expect) % F.P
+    assert unmont(am)[0] == expect
+
+
+def test_inversion():
+    xs = [x for x in SAMPLES if x != 0]
+    got = unmont(F.fp_inv(mont(xs)))
+    for x, g in zip(xs, got):
+        assert (x * g) % F.P == 1
+    assert unmont(F.fp_inv(mont([0])))[0] == 0
+
+
+def test_sqrt():
+    squares = [(x * x) % F.P for x in SAMPLES if x]
+    got = unmont(F.fp_sqrt_candidate(mont(squares)))
+    for sq, g in zip(squares, got):
+        assert (g * g) % F.P == sq
+
+
+def test_broadcasting():
+    a = mont(SAMPLES)
+    one = np.asarray(F.ONE_MONT)
+    got = unmont(F.fp_mont_mul(a, one))
+    assert got == [x % F.P for x in SAMPLES]
