@@ -1,0 +1,160 @@
+"""Batched BLS12-381 scalar-field (Fr, 255-bit) arithmetic + NTT as JAX kernels.
+
+The sharding/DAS layer of the reference (specs/sharding/beacon-chain.md:104-174,
+specs/das/das-core.md:90-129) does polynomial commitments and data-availability
+erasure coding over the curve's *scalar* field Fr (MODULUS = curve order r).
+The reference leaves this math to research-prototype Python; here it is a
+first-class TPU kernel family:
+
+  - field elements: (..., 16) uint32 limb vectors, 16 bits per limb,
+    little-endian, Montgomery domain (R = 2^256) — the shared deferred-carry
+    SOS core in ops/limb_mont.py, specialized to the scalar modulus (the base
+    field Fp in ops/fp_jax.py specializes the same factory at 24 limbs);
+  - the NTT (number-theoretic transform over the 2-adic roots of unity of Fr,
+    2-adicity 32) is an iterative radix-2 Cooley-Tukey with static shapes:
+    log2(n) stages, each one vectorized butterfly pass over the whole batch —
+    XLA sees a flat chain of ~log2(n) fused elementwise stages, no dynamic
+    control flow;
+  - polynomial-eval extension (the DAS "extend by 2x" primitive) and coset
+    evaluation build on the NTT.
+
+Differential oracle: plain Python pow/mult mod r (host_* helpers below).
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .limb_mont import MontgomeryField
+
+# Curve order of BLS12-381 (the "inner" / scalar modulus, reference
+# specs/sharding/beacon-chain.md:107) and its primitive root 7 (:104).
+R_MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+PRIMITIVE_ROOT = 7
+TWO_ADICITY = 32
+assert (R_MODULUS - 1) % (1 << TWO_ADICITY) == 0
+
+NLIMBS = 16
+FIELD = MontgomeryField(R_MODULUS, NLIMBS)
+
+# Established public surface (bound to the shared factory instance).
+int_to_limbs = FIELD.int_to_limbs
+limbs_to_int = FIELD.limbs_to_int
+to_mont = FIELD.to_mont
+from_mont_int = FIELD.from_mont_int
+ints_to_mont_batch = FIELD.ints_to_mont_batch
+mont_batch_to_ints = FIELD.mont_batch_to_ints
+ONE_MONT = FIELD.one_mont
+MOD_LIMBS = FIELD.mod_limbs
+
+fr_add = FIELD.add
+fr_sub = FIELD.sub
+fr_mul = FIELD.mont_mul
+fr_pow_const = FIELD.pow_const
+fr_inv = FIELD.inv
+
+
+# --- roots of unity / domains -----------------------------------------------
+
+
+def root_of_unity(order: int) -> int:
+    """Primitive `order`-th root of unity in Fr (order a power of two ≤ 2^32).
+
+    Matches the reference's ROOT_OF_UNITY derivation
+    (specs/sharding/beacon-chain.md:174): 7^((r-1)/order) mod r."""
+    assert order & (order - 1) == 0 and order <= (1 << TWO_ADICITY)
+    return pow(PRIMITIVE_ROOT, (R_MODULUS - 1) // order, R_MODULUS)
+
+
+def domain(n: int) -> list[int]:
+    """[w^0, w^1, ..., w^(n-1)] for the n-th root w (host ints)."""
+    w = root_of_unity(n)
+    out, acc = [], 1
+    for _ in range(n):
+        out.append(acc)
+        acc = acc * w % R_MODULUS
+    return out
+
+
+def _twiddle_tables(n: int, inverse: bool) -> list[np.ndarray]:
+    """Per-stage Montgomery twiddle tables for the DIT NTT below.
+
+    Stage s (s = 1..log2 n) works on blocks of size 2^s and needs the first
+    2^(s-1) powers of the 2^s-th root (or its inverse)."""
+    tables = []
+    m = 2
+    while m <= n:
+        w = root_of_unity(m)
+        if inverse:
+            w = pow(w, R_MODULUS - 2, R_MODULUS)
+        tables.append(ints_to_mont_batch([pow(w, k, R_MODULUS) for k in range(m // 2)]))
+        m *= 2
+    return tables
+
+
+def _bit_reverse_perm(n: int) -> np.ndarray:
+    bits = n.bit_length() - 1
+    idx = np.arange(n)
+    rev = np.zeros(n, dtype=np.int64)
+    for b in range(bits):
+        rev |= ((idx >> b) & 1) << (bits - 1 - b)
+    return rev
+
+
+def _ntt_impl(values: jax.Array, tables) -> jax.Array:
+    """Iterative radix-2 DIT over (..., n, 16) Montgomery limbs."""
+    n = values.shape[-2]
+    x = values[..., jnp.asarray(_bit_reverse_perm(n)), :]
+    for s, table in enumerate(tables):
+        half = 1 << s
+        blocks = n // (2 * half)
+        xb = x.reshape(x.shape[:-2] + (blocks, 2, half, NLIMBS))
+        lo = xb[..., 0, :, :]
+        hi = fr_mul(xb[..., 1, :, :], jnp.asarray(table))
+        out = jnp.stack([fr_add(lo, hi), fr_sub(lo, hi)], axis=-3)
+        x = out.reshape(x.shape)
+    return x
+
+
+@lru_cache(maxsize=None)
+def make_ntt(n: int, inverse: bool = False):
+    """Build a jitted NTT (or inverse NTT) of static size n over (..., n, 16)
+    Montgomery-limb arrays. Inverse includes the 1/n scaling.
+
+    Cached per (n, inverse): callers (das extension/recovery hit five domains
+    per blob) must share one jitted closure per domain or XLA recompiles the
+    butterfly chain every call."""
+    tables = _twiddle_tables(n, inverse)
+    n_inv_mont = jnp.asarray(to_mont(pow(n, R_MODULUS - 2, R_MODULUS)))
+
+    @jax.jit
+    def ntt(values: jax.Array) -> jax.Array:
+        out = _ntt_impl(values, tables)
+        if inverse:
+            out = fr_mul(out, n_inv_mont)
+        return out
+
+    return ntt
+
+
+# --- host oracle -------------------------------------------------------------
+
+
+def host_ntt(values: list[int], inverse: bool = False) -> list[int]:
+    """O(n^2) reference DFT over Fr (host ints) for differential tests."""
+    n = len(values)
+    w = root_of_unity(n)
+    if inverse:
+        w = pow(w, R_MODULUS - 2, R_MODULUS)
+    out = []
+    for i in range(n):
+        acc = 0
+        for j, v in enumerate(values):
+            acc = (acc + v * pow(w, i * j, R_MODULUS)) % R_MODULUS
+        if inverse:
+            acc = acc * pow(n, R_MODULUS - 2, R_MODULUS) % R_MODULUS
+        out.append(acc)
+    return out

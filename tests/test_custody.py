@@ -1,0 +1,98 @@
+"""Custody game crypto: Legendre PRF, UHF, custody-bit pipeline.
+
+Parity checks against specs/custody_game/beacon-chain.md semantics
+(legendre_bit :263, get_custody_atoms :285, get_custody_secrets :303,
+universal_hash_function :318, compute_custody_bit :331), including a
+differential test of the Euler-criterion legendre_bit against an
+independent Jacobi-symbol implementation."""
+import random
+
+from consensus_specs_tpu.crypto import bls_sig, custody
+
+
+def _jacobi(a: int, n: int) -> int:
+    """Independent Jacobi-symbol oracle (binary algorithm)."""
+    a %= n
+    t = 1
+    while a:
+        while a % 2 == 0:
+            a //= 2
+            if n % 8 in (3, 5):
+                t = -t
+        a, n = n, a
+        if a % 4 == 3 and n % 4 == 3:
+            t = -t
+        a %= n
+    return t if n == 1 else 0
+
+
+def test_legendre_bit_small_prime():
+    # QRs mod 11: 1,3,4,5,9
+    assert [custody.legendre_bit(a, 11) for a in range(11)] == [0, 1, 0, 1, 1, 1, 0, 0, 0, 1, 0]
+
+
+def test_legendre_bit_matches_jacobi_oracle():
+    rng = random.Random(1)
+    q = custody.CUSTODY_PRIME
+    for _ in range(20):
+        a = rng.randrange(2 * q)  # include a >= q reduction cases
+        assert custody.legendre_bit(a, q) == (_jacobi(a, q) + 1) // 2
+
+
+def test_legendre_multiplicativity():
+    rng = random.Random(2)
+    q = custody.CUSTODY_PRIME
+    for _ in range(10):
+        a, b = rng.randrange(1, q), rng.randrange(1, q)
+        la, lb = custody.legendre_bit(a, q), custody.legendre_bit(b, q)
+        lab = custody.legendre_bit(a * b % q, q)
+        assert lab == 1 if la == lb else lab == 0
+
+
+def test_custody_atoms_padding():
+    atoms = custody.get_custody_atoms(b"z" * 33)
+    assert len(atoms) == 2
+    assert atoms[0] == b"z" * 32
+    assert atoms[1] == b"z" + b"\x00" * 31
+    assert custody.get_custody_atoms(b"") == []
+
+
+def test_custody_secrets_shape():
+    sig = bls_sig.Sign(7, b"period randao message")
+    secrets = custody.get_custody_secrets(sig)
+    assert len(secrets) == custody.CUSTODY_SECRETS
+    assert all(0 <= s < 2**256 for s in secrets)
+    # deterministic in the signature
+    assert secrets == custody.get_custody_secrets(sig)
+
+
+def test_uhf_length_binding():
+    sig = bls_sig.Sign(8, b"key")
+    secrets = custody.get_custody_secrets(sig)
+    a = custody.universal_hash_function([b"\x01" * 32], secrets)
+    b = custody.universal_hash_function([b"\x01" * 32, b"\x00" * 32], secrets)
+    assert a != b  # appending a zero atom changes the digest (length term)
+
+
+def test_custody_bit_deterministic_and_key_sensitive():
+    data = bytes(range(256)) * 8
+    sig1 = bls_sig.Sign(21, b"reveal epoch 1")
+    sig2 = bls_sig.Sign(22, b"reveal epoch 1")
+    b1 = custody.compute_custody_bit(sig1, data)
+    assert b1 in (0, 1)
+    assert b1 == custody.compute_custody_bit(sig1, data)
+    # different secrets give an independent PRF (bits may coincide; digests not)
+    s1 = custody.universal_hash_function(custody.get_custody_atoms(data), custody.get_custody_secrets(sig1))
+    s2 = custody.universal_hash_function(custody.get_custody_atoms(data), custody.get_custody_secrets(sig2))
+    assert s1 != s2
+
+
+def test_custody_period_helpers():
+    # get_custody_period_for_validator: offset staggering by validator index
+    assert custody.get_custody_period_for_validator(0, 0) == 0
+    p = custody.EPOCHS_PER_CUSTODY_PERIOD
+    assert custody.get_custody_period_for_validator(0, p) == 1
+    assert custody.get_custody_period_for_validator(1, p - 1) == 1  # staggered boundary
+    # randao epoch for a period lands one padding past the period end
+    e = custody.get_randao_epoch_for_custody_period(0, 0)
+    assert e == p + custody.CUSTODY_PERIOD_TO_RANDAO_PADDING

@@ -1,0 +1,94 @@
+"""Scalar-field (Fr) kernels: limb arithmetic and NTT vs host oracle.
+
+Mirrors the differential-testing strategy used for the Fp kernels
+(tests/test_fp_jax.py): every device op is checked against plain Python
+bignum math over the curve order (reference MODULUS,
+specs/sharding/beacon-chain.md:107)."""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops import fr_jax as fr
+
+rng = random.Random(0xF12)
+
+
+def rand_elems(n):
+    return [rng.randrange(fr.R_MODULUS) for _ in range(n)]
+
+
+def test_limb_roundtrip():
+    xs = rand_elems(4) + [0, 1, fr.R_MODULUS - 1]
+    for x in xs:
+        assert fr.from_mont_int(fr.to_mont(x)) == x
+
+
+@pytest.mark.parametrize("op,ref", [
+    ("fr_add", lambda x, y: (x + y) % fr.R_MODULUS),
+    ("fr_sub", lambda x, y: (x - y) % fr.R_MODULUS),
+    ("fr_mul", lambda x, y: x * y % fr.R_MODULUS),
+])
+def test_binary_ops(op, ref):
+    xs, ys = rand_elems(16), rand_elems(16)
+    # include edge operands
+    xs[0], ys[0] = 0, 0
+    xs[1], ys[1] = fr.R_MODULUS - 1, fr.R_MODULUS - 1
+    a, b = fr.ints_to_mont_batch(xs), fr.ints_to_mont_batch(ys)
+    got = fr.mont_batch_to_ints(getattr(fr, op)(a, b))
+    assert got == [ref(x, y) for x, y in zip(xs, ys)]
+
+
+def test_inversion():
+    xs = rand_elems(8)
+    got = fr.mont_batch_to_ints(fr.fr_inv(fr.ints_to_mont_batch(xs)))
+    assert got == [pow(x, -1, fr.R_MODULUS) for x in xs]
+
+
+def test_root_of_unity_orders():
+    for order in (2, 8, 1 << 10):
+        w = fr.root_of_unity(order)
+        assert pow(w, order, fr.R_MODULUS) == 1
+        assert pow(w, order // 2, fr.R_MODULUS) != 1
+
+
+@pytest.mark.parametrize("n", [4, 16, 64])
+def test_ntt_matches_host_dft(n):
+    vals = rand_elems(n)
+    ntt = fr.make_ntt(n)
+    got = fr.mont_batch_to_ints(ntt(np.asarray(fr.ints_to_mont_batch(vals))))
+    assert got == fr.host_ntt(vals)
+
+
+def test_intt_roundtrip():
+    n = 32
+    vals = rand_elems(n)
+    fwd, inv = fr.make_ntt(n), fr.make_ntt(n, inverse=True)
+    x = np.asarray(fr.ints_to_mont_batch(vals))
+    assert fr.mont_batch_to_ints(inv(fwd(x))) == vals
+
+
+def test_ntt_batched_leading_axis():
+    """(B, n, 16) transforms each row independently."""
+    n, B = 8, 3
+    rows = [rand_elems(n) for _ in range(B)]
+    fwd = fr.make_ntt(n)
+    stacked = np.stack([fr.ints_to_mont_batch(r) for r in rows])
+    out = fwd(stacked)
+    for i, r in enumerate(rows):
+        assert fr.mont_batch_to_ints(np.asarray(out)[i]) == fr.host_ntt(r)
+
+
+def test_ntt_is_polynomial_evaluation():
+    """NTT(coeffs)[i] == P(w^i) — the property KZG/DAS rely on."""
+    n = 16
+    coeffs = rand_elems(n)
+    fwd = fr.make_ntt(n)
+    evals = fr.mont_batch_to_ints(fwd(np.asarray(fr.ints_to_mont_batch(coeffs))))
+    w = fr.root_of_unity(n)
+    for i in (0, 1, 7, n - 1):
+        x = pow(w, i, fr.R_MODULUS)
+        expect = 0
+        for c in reversed(coeffs):
+            expect = (expect * x + c) % fr.R_MODULUS
+        assert evals[i] == expect

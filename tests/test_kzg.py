@@ -1,0 +1,104 @@
+"""KZG10 commitments: commit/open/verify, degree proofs, coset multiproofs.
+
+Covers the sharding spec's pairing checks (process_shard_header degree
+verification, reference specs/sharding/beacon-chain.md:716-766) and the DAS
+spec's check_multi_kzg_proof (specs/das/das-core.md:131-137), including
+negative cases (forged evaluations, wrong degree bounds)."""
+import random
+
+import pytest
+
+from consensus_specs_tpu.crypto import kzg
+
+rng = random.Random(0xC0DE)
+SETUP = kzg.insecure_test_setup(16)
+
+
+def rand_poly(n):
+    return [rng.randrange(kzg.MODULUS) for _ in range(n)]
+
+
+def test_commit_linear():
+    """commit(a + b) == commit(a) + commit(b) — homomorphism sanity."""
+    from consensus_specs_tpu.crypto.bls12_381 import FP_FIELD, pt_add, pt_eq
+
+    a, b = rand_poly(6), rand_poly(6)
+    s = [(x + y) % kzg.MODULUS for x, y in zip(a, b)]
+    lhs = kzg.commit(SETUP, s)
+    rhs = pt_add(FP_FIELD, kzg.commit(SETUP, a), kzg.commit(SETUP, b))
+    assert pt_eq(FP_FIELD, lhs, rhs)
+
+
+def test_open_verify_roundtrip():
+    coeffs = rand_poly(8)
+    C = kzg.commit(SETUP, coeffs)
+    z = rng.randrange(kzg.MODULUS)
+    proof, y = kzg.prove_at(SETUP, coeffs, z)
+    assert y == kzg.eval_poly_at(coeffs, z)
+    assert kzg.verify_at(SETUP, C, z, y, proof)
+
+
+def test_open_rejects_wrong_value():
+    coeffs = rand_poly(8)
+    C = kzg.commit(SETUP, coeffs)
+    z = rng.randrange(kzg.MODULUS)
+    proof, y = kzg.prove_at(SETUP, coeffs, z)
+    assert not kzg.verify_at(SETUP, C, z, (y + 1) % kzg.MODULUS, proof)
+    # proof for a different point must not verify at z
+    z2 = (z + 1) % kzg.MODULUS
+    proof2, y2 = kzg.prove_at(SETUP, coeffs, z2)
+    assert not kzg.verify_at(SETUP, C, z, y, proof2)
+
+
+def test_degree_proof_accepts_true_bound():
+    coeffs = rand_poly(8)
+    C = kzg.commit(SETUP, coeffs)
+    dp = kzg.prove_degree_bound(SETUP, coeffs, 8)
+    assert kzg.verify_degree_proof(SETUP, C, dp, 8)
+
+
+def test_degree_proof_rejects_tighter_bound():
+    """A degree-11 polynomial cannot satisfy a 'deg < 8' proof check."""
+    coeffs = rand_poly(12)
+    C = kzg.commit(SETUP, coeffs)
+    dp = kzg.prove_degree_bound(SETUP, coeffs, 12)
+    assert kzg.verify_degree_proof(SETUP, C, dp, 12)
+    assert not kzg.verify_degree_proof(SETUP, C, dp, 8)
+
+
+def test_prover_cannot_claim_violated_bound():
+    coeffs = rand_poly(12)
+    with pytest.raises(AssertionError):
+        kzg.prove_degree_bound(SETUP, coeffs, 8)
+
+
+@pytest.mark.parametrize("m", [2, 4])
+def test_coset_multiproof(m):
+    coeffs = rand_poly(8)
+    C = kzg.commit(SETUP, coeffs)
+    shift = 5
+    proof, ys = kzg.prove_coset(SETUP, coeffs, shift, m)
+    assert kzg.verify_coset(SETUP, C, shift, ys, proof)
+    # check ys really are the coset evaluations
+    from consensus_specs_tpu.ops.fr_jax import root_of_unity
+
+    w = root_of_unity(m)
+    for i, y in enumerate(ys):
+        assert y == kzg.eval_poly_at(coeffs, shift * pow(w, i, kzg.MODULUS) % kzg.MODULUS)
+
+
+def test_coset_multiproof_rejects_forgery():
+    coeffs = rand_poly(8)
+    C = kzg.commit(SETUP, coeffs)
+    proof, ys = kzg.prove_coset(SETUP, coeffs, 5, 4)
+    bad = list(ys)
+    bad[2] = (bad[2] + 1) % kzg.MODULUS
+    assert not kzg.verify_coset(SETUP, C, 5, bad, proof)
+    # and against the wrong commitment
+    C2 = kzg.commit(SETUP, rand_poly(8))
+    assert not kzg.verify_coset(SETUP, C2, 5, ys, proof)
+
+
+def test_commitment_serialization():
+    data = kzg.commit_bytes(SETUP, rand_poly(4))
+    assert len(data) == 48 and data[0] & 0x80  # compressed flag
