@@ -313,8 +313,13 @@ _GAMMA2 = [
 
 
 def _const_f2_stack(gammas):
-    re = jnp.asarray(np.stack([to_mont(g[0]) for g in gammas]))
-    im = jnp.asarray(np.stack([to_mont(g[1]) for g in gammas]))
+    # numpy (NOT jnp): these are cached in module globals, and the first
+    # pairing call may happen inside a jit trace — a cached jnp constant
+    # created there would be a DynamicJaxprTracer leaking into later traces
+    # (UnexpectedTracerError on the second jitted pairing). numpy constants
+    # are trace-safe and embed per-trace.
+    re = np.stack([to_mont(g[0]) for g in gammas])
+    im = np.stack([to_mont(g[1]) for g in gammas])
     return re, im
 
 

@@ -14,19 +14,20 @@ Representation:
     lax.fori_loop with dynamic slices, keeping the HLO graph small (see
     ops/sha256_jax.py for why unrolling is fatal to compile times here)
 
-Montgomery reduction is SOS (separated operand scanning): deferred carries in
-uint64 columns with the per-limb carry folded upward each round; column
-magnitudes stay below ~2^41, far from the uint64 ceiling.
+The Montgomery SOS core (deferred carries in uint64 columns, per-limb carry
+folded upward each round; magnitudes < ~2^41, far from the uint64 ceiling)
+lives in ops/limb_mont.py, shared with the scalar field Fr (ops/fr_jax.py).
+This module binds the 24-limb Fp specialization plus Fp-specific extras
+(sqrt candidate for point decompression, lazy-reduction stack summation for
+point-add chains).
 """
 from __future__ import annotations
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 import numpy as np
-from functools import partial
+
+from .limb_mont import MontgomeryField
 
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 
@@ -37,187 +38,33 @@ BASE = jnp.uint64(1 << LIMB_BITS)
 R = 1 << (NLIMBS * LIMB_BITS)  # 2^384
 R_MOD_P = R % P
 R2_MOD_P = (R * R) % P
-# -p^-1 mod 2^16 (Montgomery n')
-N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
 
+FIELD = MontgomeryField(P, NLIMBS)
+N0 = FIELD.n0  # -p^-1 mod 2^16 (Montgomery n')
 
-def int_to_limbs(x: int) -> np.ndarray:
-    assert 0 <= x < (1 << 384)
-    return np.array([(x >> (LIMB_BITS * i)) & MASK for i in range(NLIMBS)], dtype=np.uint32)
+# Established public surface (bound to the shared factory instance).
+int_to_limbs = FIELD.int_to_limbs
+limbs_to_int = FIELD.limbs_to_int
+to_mont = FIELD.to_mont
+from_mont_int = FIELD.from_mont_int
 
-
-def limbs_to_int(limbs) -> int:
-    arr = np.asarray(limbs, dtype=np.uint64).reshape(-1)
-    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
-
-
-P_LIMBS = int_to_limbs(P)
+P_LIMBS = FIELD.mod_limbs
 _P64 = jnp.asarray(P_LIMBS.astype(np.uint64))
-ZERO = np.zeros(NLIMBS, dtype=np.uint32)
-ONE_MONT = int_to_limbs(R_MOD_P)  # 1 in Montgomery form
+ZERO = FIELD.zero
+ONE_MONT = FIELD.one_mont
 
+fp_add = FIELD.add
+fp_sub = FIELD.sub
+fp_neg = FIELD.neg
+fp_mont_mul = FIELD.mont_mul
+fp_mont_sqr = FIELD.mont_sqr
+fp_pow_const = FIELD.pow_const
+fp_inv = FIELD.inv
 
-def to_mont(x: int) -> np.ndarray:
-    """Host: integer -> Montgomery-form limb vector."""
-    return int_to_limbs((x * R) % P)
-
-
-def from_mont_int(limbs) -> int:
-    """Host: Montgomery-form limbs -> integer."""
-    return (limbs_to_int(limbs) * pow(R, -1, P)) % P
-
-
-# --- carry / borrow primitives ----------------------------------------------
-
-
-def _carry_pass(t):
-    """(..., N) u64 deferred-carry columns -> per-limb < 2^16 except possibly
-    the last (which receives the final carry)."""
-    n = t.shape[-1]
-
-    def body(i, t):
-        v = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
-        t = jax.lax.dynamic_update_index_in_dim(t, v & jnp.uint64(MASK), i, axis=-1)
-        nxt = jax.lax.dynamic_index_in_dim(t, i + 1, axis=-1, keepdims=False)
-        return jax.lax.dynamic_update_index_in_dim(
-            t, nxt + (v >> LIMB_BITS), i + 1, axis=-1
-        )
-
-    return jax.lax.fori_loop(0, n - 1, body, t)
-
-
-def _sub_limbs(x, y):
-    """x - y over canonical (..., 24) u64 limb vectors, assuming x >= y."""
-    out = jnp.zeros(jnp.broadcast_shapes(x.shape, y.shape), dtype=jnp.uint64)
-    borrow0 = jnp.zeros(out.shape[:-1], dtype=jnp.uint64)
-    xb = jnp.broadcast_to(x, out.shape)
-    yb = jnp.broadcast_to(y, out.shape)
-
-    def body(i, st):
-        borrow, out = st
-        xi = jax.lax.dynamic_index_in_dim(xb, i, axis=-1, keepdims=False)
-        yi = jax.lax.dynamic_index_in_dim(yb, i, axis=-1, keepdims=False)
-        d = xi + BASE - yi - borrow
-        out = jax.lax.dynamic_update_index_in_dim(out, d & jnp.uint64(MASK), i, axis=-1)
-        borrow = jnp.uint64(1) - (d >> LIMB_BITS)
-        return borrow, out
-
-    _, res = jax.lax.fori_loop(0, NLIMBS, body, (borrow0, out))
-    return res
-
-
-def _geq_p(a64):
-    """canonical (..., 24) u64 >= p ? (lexicographic from the top limb)."""
-    gt = jnp.zeros(a64.shape[:-1], dtype=bool)
-    lt = jnp.zeros(a64.shape[:-1], dtype=bool)
-    for i in range(NLIMBS - 1, -1, -1):
-        ai = a64[..., i]
-        pi = _P64[i]
-        gt = gt | (~lt & (ai > pi))
-        lt = lt | (~gt & (ai < pi))
-    return ~lt
-
-
-def _cond_sub_p(a64):
-    """Subtract p where a >= p (a canonical, a < 2p)."""
-    sub = _sub_limbs(a64, _P64)
-    return jnp.where(_geq_p(a64)[..., None], sub, a64)
-
-
-# --- field ops ---------------------------------------------------------------
-
-
-@jax.jit
-def fp_add(a: jax.Array, b: jax.Array) -> jax.Array:
-    """(..., 24) u32 canonical -> canonical (a + b) mod p."""
-    t = _carry_pass(a.astype(jnp.uint64) + b.astype(jnp.uint64))
-    return _cond_sub_p(t).astype(jnp.uint32)
-
-
-@jax.jit
-def fp_sub(a: jax.Array, b: jax.Array) -> jax.Array:
-    """(..., 24) u32 canonical -> canonical (a - b) mod p."""
-    p_minus_b = _sub_limbs(_P64, b.astype(jnp.uint64))
-    t = _carry_pass(a.astype(jnp.uint64) + p_minus_b)
-    return _cond_sub_p(t).astype(jnp.uint32)
-
-
-@jax.jit
-def fp_neg(a: jax.Array) -> jax.Array:
-    """(p - a) mod p; zero stays zero."""
-    z = jnp.all(a == 0, axis=-1, keepdims=True)
-    res = _sub_limbs(_P64, a.astype(jnp.uint64))
-    return jnp.where(z, jnp.zeros_like(res), res).astype(jnp.uint32)
-
-
-def _poly_mul_acc(a64, b64):
-    """Schoolbook product columns: (..., 24) x (..., 24) -> (..., 48) u64."""
-    shape = jnp.broadcast_shapes(a64.shape[:-1], b64.shape[:-1])
-    t = jnp.zeros(shape + (2 * NLIMBS,), dtype=jnp.uint64)
-    a64 = jnp.broadcast_to(a64, shape + (NLIMBS,))
-    b64 = jnp.broadcast_to(b64, shape + (NLIMBS,))
-
-    def body(i, t):
-        ai = jax.lax.dynamic_index_in_dim(a64, i, axis=-1, keepdims=True)
-        window = jax.lax.dynamic_slice_in_dim(t, i, NLIMBS, axis=-1)
-        return jax.lax.dynamic_update_slice_in_dim(t, window + ai * b64, i, axis=-1)
-
-    return jax.lax.fori_loop(0, NLIMBS, body, t)
-
-
-@jax.jit
-def fp_mont_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """Montgomery product: (a·b·R^-1) mod p over (..., 24) u32 limbs.
-
-    Column magnitude bound: products accumulate ≤ 24·(2^16-1)^2 ≈ 2^36.6 per
-    column; each reduction round adds m·p (≤ 2^32 per column) and a folded
-    carry (≤ 2^21) — all far below 2^64.
-    """
-    t = _poly_mul_acc(a.astype(jnp.uint64), b.astype(jnp.uint64))
-    t = jnp.concatenate([t, jnp.zeros(t.shape[:-1] + (1,), jnp.uint64)], axis=-1)  # (..., 49)
-    n0 = jnp.uint64(N0)
-
-    def body(i, t):
-        ti = jax.lax.dynamic_index_in_dim(t, i, axis=-1, keepdims=False)
-        m = ((ti & jnp.uint64(MASK)) * n0) & jnp.uint64(MASK)
-        window = jax.lax.dynamic_slice_in_dim(t, i, NLIMBS, axis=-1)
-        window = window + m[..., None] * _P64
-        # t[i] is now ≡ 0 mod 2^16; move its whole value up as carry
-        carry = window[..., 0] >> LIMB_BITS
-        window = window.at[..., 0].set(jnp.uint64(0))
-        window = window.at[..., 1].add(carry)
-        return jax.lax.dynamic_update_slice_in_dim(t, window, i, axis=-1)
-
-    t = jax.lax.fori_loop(0, NLIMBS, body, t)
-    hi = _carry_pass(t[..., NLIMBS:])  # 25 columns; result < 2p fits 24
-    return _cond_sub_p(hi[..., :NLIMBS]).astype(jnp.uint32)
-
-
-@jax.jit
-def fp_mont_sqr(a: jax.Array) -> jax.Array:
-    return fp_mont_mul(a, a)
-
-
-@partial(jax.jit, static_argnums=(1,))
-def fp_pow_const(a: jax.Array, exponent: int) -> jax.Array:
-    """a^exponent via square-and-multiply over the constant's bits (MSB-first).
-
-    a in Montgomery form; exponent is a static Python int (e.g. p-2 for
-    inversion, (p+1)/4 for sqrt). a == 0 yields 0 for exponent >= 1."""
-    bits = jnp.asarray(np.array([int(c) for c in bin(exponent)[2:]], dtype=np.int32))
-    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.uint32)
-
-    def body(i, acc):
-        acc = fp_mont_mul(acc, acc)
-        mul = fp_mont_mul(acc, a)
-        return jnp.where(bits[i] == 1, mul, acc)
-
-    return jax.lax.fori_loop(0, bits.shape[0], body, one)
-
-
-def fp_inv(a: jax.Array) -> jax.Array:
-    """Batched inversion (Fermat): a^(p-2). Zero maps to zero."""
-    return fp_pow_const(a, P - 2)
+# shared primitives reused by the Fp-specific extras below
+_carry_pass = FIELD.carry_pass
+_sub_limbs = FIELD.sub_limbs
+_geq_vec = FIELD.geq_vec
 
 
 def fp_sqrt_candidate(a: jax.Array) -> jax.Array:
@@ -230,17 +77,6 @@ def fp_sqrt_candidate(a: jax.Array) -> jax.Array:
 # p·2^j limb vectors for conditional subtraction of accumulated sums (< 8p;
 # 8p < 2^384 so intermediates stay canonical in 24 limbs — 16p would not)
 _P_MULTIPLES = [jnp.asarray(int_to_limbs((P << j))).astype(jnp.uint64) for j in range(3)]
-
-
-def _geq_vec(a64, vec):
-    gt = jnp.zeros(a64.shape[:-1], dtype=bool)
-    lt = jnp.zeros(a64.shape[:-1], dtype=bool)
-    for i in range(NLIMBS - 1, -1, -1):
-        ai = a64[..., i]
-        vi = vec[i]
-        gt = gt | (~lt & (ai > vi))
-        lt = lt | (~gt & (ai < vi))
-    return ~lt
 
 
 def fp_sum_stack(arr, axis: int = 0) -> jax.Array:
@@ -261,15 +97,5 @@ def fp_sum_stack(arr, axis: int = 0) -> jax.Array:
 
 # --- host codecs ------------------------------------------------------------
 
-
-def ints_to_mont_batch(xs) -> np.ndarray:
-    """Host: iterable of ints -> (N, 24) u32 Montgomery batch."""
-    xs = list(xs)
-    if not xs:
-        return np.zeros((0, NLIMBS), np.uint32)
-    return np.stack([to_mont(int(x) % P) for x in xs])
-
-
-def mont_batch_to_ints(arr) -> list[int]:
-    a = np.asarray(arr, dtype=np.uint32)
-    return [from_mont_int(a[i]) for i in range(a.shape[0])]
+ints_to_mont_batch = FIELD.ints_to_mont_batch
+mont_batch_to_ints = FIELD.mont_batch_to_ints
