@@ -25,9 +25,12 @@ def generate_from_tests(
     preset_name: str,
     suite_name: str = "pyspec_tests",
     bls_active: bool = True,
+    name_prefix: str = "",
 ) -> Iterable[TestCase]:
+    """name_prefix filters to tests named test_<prefix>* — lets one module
+    back multiple handlers (e.g. genesis initialization vs validity)."""
     for name, fn in inspect.getmembers(src, inspect.isfunction):
-        if not name.startswith("test_"):
+        if not name.startswith("test_" + name_prefix):
             continue
         run_phases = getattr(fn, "run_phases", None)
         if run_phases is not None and fork_name not in run_phases:
@@ -77,11 +80,15 @@ def run_state_test_generators(
         for fork_name, handlers in all_mods.items():
             for handler_name, mods in handlers.items():
                 for mod in mods if isinstance(mods, list) else [mods]:
+                    prefix = ""
+                    if isinstance(mod, tuple):
+                        mod, prefix = mod
                     if isinstance(mod, str):
                         mod = importlib.import_module(mod)
                     for preset_name in presets:
                         yield from generate_from_tests(
-                            runner_name, handler_name, mod, fork_name, preset_name
+                            runner_name, handler_name, mod, fork_name, preset_name,
+                            name_prefix=prefix,
                         )
 
     def prepare():

@@ -1,0 +1,75 @@
+"""Dual-mode finality tests: justification/finalization over attested epochs.
+
+Vector format (reference tests/formats/finality = sanity-blocks shape):
+pre, blocks_<i>, post, meta {blocks_count}. Reference parity:
+test/phase0/finality/test_finality.py scenarios (rule-1/2/3/4 finalization
+shapes condensed to the canonical full-participation and skip cases).
+"""
+from ..testlib.attestations import next_epoch_with_attestations
+from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.state import next_epoch
+
+
+def _run_epochs(spec, state, epochs, fill_cur, fill_prev):
+    blocks = []
+    for _ in range(epochs):
+        _, bs, _ = next_epoch_with_attestations(spec, state, fill_cur, fill_prev)
+        blocks.extend(bs)
+    return blocks
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_no_updates_at_genesis(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    yield "pre", state.copy()
+    blocks = _run_epochs(spec, state, 2, True, False)
+    yield "meta", "meta", {"blocks_count": len(blocks)}
+    for i, b in enumerate(blocks):
+        yield f"blocks_{i}", b
+    # no finality processing inside the first two epochs
+    assert int(state.finalized_checkpoint.epoch) == int(spec.GENESIS_EPOCH)
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_4(spec, state):
+    """Two consecutive justified epochs finalize the older (rule 4)."""
+    yield "pre", state.copy()
+    blocks = _run_epochs(spec, state, 4, True, False)
+    yield "meta", "meta", {"blocks_count": len(blocks)}
+    for i, b in enumerate(blocks):
+        yield f"blocks_{i}", b
+    current = int(spec.get_current_epoch(state))
+    assert int(state.current_justified_checkpoint.epoch) == current - 1
+    assert int(state.finalized_checkpoint.epoch) == current - 2
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_1_prev_epoch_attestations(spec, state):
+    """Previous-epoch-only attestations: justification lands with a one-epoch
+    lag (justified = current - 2) and rule 1 finalizes at current - 4."""
+    yield "pre", state.copy()
+    blocks = _run_epochs(spec, state, 5, False, True)
+    yield "meta", "meta", {"blocks_count": len(blocks)}
+    for i, b in enumerate(blocks):
+        yield f"blocks_{i}", b
+    current = int(spec.get_current_epoch(state))
+    assert int(state.current_justified_checkpoint.epoch) == current - 2
+    assert int(state.finalized_checkpoint.epoch) == current - 4
+    yield "post", state.copy()
+
+
+@with_all_phases
+@spec_state_test
+def test_no_finality_without_attestations(spec, state):
+    yield "pre", state.copy()
+    for _ in range(4):
+        next_epoch(spec, state)
+    yield "meta", "meta", {"blocks_count": 0}
+    assert int(state.finalized_checkpoint.epoch) == int(spec.GENESIS_EPOCH)
+    assert int(state.current_justified_checkpoint.epoch) == int(spec.GENESIS_EPOCH)
+    yield "post", state.copy()

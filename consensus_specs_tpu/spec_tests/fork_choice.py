@@ -1,0 +1,108 @@
+"""Dual-mode fork-choice tests: scripted store scenarios emitting steps.yaml.
+
+Vector format (reference tests/formats/fork_choice): anchor_state/
+anchor_block ssz, per-object block_*/attestation_* ssz, steps.yaml of
+{tick|block|attestation|checks} entries. Reference parity:
+test/phase0/fork_choice/test_get_head.py, test_on_block.py scenarios.
+"""
+from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.block import build_empty_block, state_transition_and_sign_block
+from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.fork_choice import (
+    add_attestation_step,
+    add_block_step,
+    add_checks_step,
+    finalize_steps,
+    initialize_steps,
+    tick_to_slot_step,
+)
+from ..testlib.state import next_slots
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_head(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    head = add_checks_step(spec, store, steps)
+    assert store.blocks[head].slot == spec.GENESIS_SLOT
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_chain_no_attestations(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    for slot in range(1, 4):
+        block = build_empty_block(spec, state, spec.Slot(slot))
+        signed = state_transition_and_sign_block(spec, state, block)
+        tick_to_slot_step(spec, store, steps, slot)
+        add_block_step(spec, store, parts, steps, signed)
+    head = add_checks_step(spec, store, steps)
+    assert store.blocks[head].slot == 3
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_shifts_head(spec, state):
+    """Two competing single-block branches; one attestation decides."""
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 2)
+
+    state_a = state.copy()
+    block_a = build_empty_block(spec, state_a, spec.Slot(1))
+    block_a.body.graffiti = spec.Bytes32(b"\x01" * 32)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    add_block_step(spec, store, parts, steps, signed_a)
+
+    state_b = state.copy()
+    block_b = build_empty_block(spec, state_b, spec.Slot(1))
+    block_b.body.graffiti = spec.Bytes32(b"\x02" * 32)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    add_block_step(spec, store, parts, steps, signed_b)
+
+    # deterministic pre-attestation head (lexicographic tiebreak)
+    add_checks_step(spec, store, steps)
+
+    # attest to the branch that is NOT the current head
+    head = spec.get_head(store)
+    loser_state, loser_root = (
+        (state_a, spec.hash_tree_root(block_a))
+        if head != spec.hash_tree_root(block_a)
+        else (state_b, spec.hash_tree_root(block_b))
+    )
+    next_slots(spec, loser_state, 1)
+    att = get_valid_attestation(spec, loser_state, slot=spec.Slot(1), signed=True)
+    add_attestation_step(spec, store, parts, steps, att)
+    head = add_checks_step(spec, store, steps)
+    assert head == loser_root
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_slot_invalid(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    # never ticked: store time is at genesis, block is from the future
+    add_block_step(spec, store, parts, steps, signed, valid=False)
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_is_set_and_reset(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick to the block's own slot (timely) -> boost set
+    tick_to_slot_step(spec, store, steps, 1)
+    root = add_block_step(spec, store, parts, steps, signed)
+    assert store.proposer_boost_root == root
+    add_checks_step(spec, store, steps)
+    # next slot tick resets the boost
+    tick_to_slot_step(spec, store, steps, 2)
+    assert store.proposer_boost_root == spec.Root()
+    add_checks_step(spec, store, steps)
+    yield from finalize_steps(parts, steps)

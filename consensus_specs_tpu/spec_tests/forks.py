@@ -1,0 +1,55 @@
+"""Dual-mode fork-upgrade tests: upgrade_to_<fork> state conversions.
+
+Vector format (reference tests/formats/forks): pre.ssz_snappy (previous
+fork's state), post.ssz_snappy (upgraded state), meta {fork}. Reference
+parity: test/altair/fork/test_altair_fork_basic.py and the bellatrix
+equivalents.
+"""
+from ..testlib.context import ALTAIR, BELLATRIX, PHASE0, spec_test, with_phases
+from ..testlib.genesis import create_valid_beacon_state
+from ..testlib.state import next_epoch
+
+
+def _upgrade_case(spec, post_spec, upgrade_fn_name, fork_name, advance_epochs=0):
+    state = create_valid_beacon_state(spec)
+    for _ in range(advance_epochs):
+        next_epoch(spec, state)
+    yield "pre", state.copy()
+    yield "meta", "meta", {"fork": fork_name}
+    post = getattr(post_spec, upgrade_fn_name)(state)
+    # invariants every upgrade must keep
+    assert post.genesis_time == state.genesis_time
+    assert post.genesis_validators_root == state.genesis_validators_root
+    assert post.slot == state.slot
+    assert len(post.validators) == len(state.validators)
+    assert post.fork.current_version == post_spec.config.__getattribute__(
+        f"{fork_name.upper()}_FORK_VERSION"
+    )
+    assert post.fork.previous_version == state.fork.current_version
+    yield "post", post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_base_state_to_altair(spec, state=None, phases=None):
+    yield from _upgrade_case(spec, phases[ALTAIR], "upgrade_to_altair", "altair")
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_next_epoch_to_altair(spec, state=None, phases=None):
+    yield from _upgrade_case(spec, phases[ALTAIR], "upgrade_to_altair", "altair", advance_epochs=1)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_fork_base_state_to_bellatrix(spec, state=None, phases=None):
+    yield from _upgrade_case(spec, phases[BELLATRIX], "upgrade_to_bellatrix", "bellatrix")
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_fork_next_epoch_to_bellatrix(spec, state=None, phases=None):
+    yield from _upgrade_case(
+        spec, phases[BELLATRIX], "upgrade_to_bellatrix", "bellatrix", advance_epochs=1
+    )

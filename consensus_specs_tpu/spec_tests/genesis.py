@@ -1,0 +1,91 @@
+"""Dual-mode genesis tests: initialization from deposits + validity checks.
+
+Vector formats (reference tests/formats/genesis): initialization cases
+carry eth1.yaml (block hash/timestamp), deposits_<i>.ssz_snappy, meta
+{deposits_count}, and the resulting state.ssz_snappy; validity cases carry
+genesis.ssz_snappy + is_valid.yaml.
+
+Reference parity: test/phase0/genesis/test_initialization.py,
+test_validity.py.
+"""
+from ..testlib.context import PHASE0, spec_test, with_phases
+from ..testlib.deposits import prepare_genesis_deposits
+
+ETH1_BLOCK_HASH = b"\x12" * 32
+ETH1_TIMESTAMP = 1578009600  # reference MIN_GENESIS_TIME ballpark
+
+
+def _min_count(spec):
+    return int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+
+
+@with_phases([PHASE0])
+@spec_test
+
+def test_initialize_beacon_state_from_eth1(spec):
+    deposits, deposit_root = prepare_genesis_deposits(spec, _min_count(spec))
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + ETH1_BLOCK_HASH.hex(),
+        "eth1_timestamp": ETH1_TIMESTAMP,
+    }
+    yield "meta", "meta", {"deposits_count": len(deposits)}
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    assert state.eth1_data.deposit_root == deposit_root
+    assert int(state.eth1_data.deposit_count) == len(deposits)
+    assert len(state.validators) == len(deposits)
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+
+def test_initialize_incomplete_deposits_not_valid(spec):
+    count = max(_min_count(spec) - 1, 1)
+    deposits, _ = prepare_genesis_deposits(spec, count)
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + ETH1_BLOCK_HASH.hex(),
+        "eth1_timestamp": ETH1_TIMESTAMP,
+    }
+    yield "meta", "meta", {"deposits_count": len(deposits)}
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    # state builds fine, it is just not launch-ready
+    assert not spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+
+def test_validity_valid_genesis(spec):
+    deposits, _ = prepare_genesis_deposits(spec, _min_count(spec))
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    yield "genesis", state
+    valid = spec.is_valid_genesis_state(state)
+    assert valid
+    yield "is_valid", "data", bool(valid)
+
+
+@with_phases([PHASE0])
+@spec_test
+
+def test_validity_too_early(spec):
+    deposits, _ = prepare_genesis_deposits(spec, _min_count(spec))
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    state.genesis_time = spec.uint64(int(spec.config.MIN_GENESIS_TIME) - 1)
+    yield "genesis", state
+    valid = spec.is_valid_genesis_state(state)
+    assert not valid
+    yield "is_valid", "data", bool(valid)

@@ -1,0 +1,85 @@
+"""Dual-mode cross-fork transition tests: chains that straddle a fork epoch.
+
+Vector format (reference tests/formats/transition): meta {post_fork,
+fork_epoch, fork_block (index of last pre-fork block), blocks_count},
+pre.ssz_snappy (pre-fork type), blocks_<i>.ssz_snappy (mixed fork types),
+post.ssz_snappy (post-fork type). Reference parity:
+test/altair/transition/test_transition.py via with_fork_metas
+(context.py:564-593); here the fork epoch is pinned with a
+config-overridden spec build (compiler build_spec(config_overrides=...)).
+"""
+from ..compiler import build_spec
+from ..testlib.block import build_empty_block_for_next_slot, state_transition_and_sign_block
+from ..testlib.context import ALTAIR, BELLATRIX, PHASE0, spec_test, with_phases
+from ..testlib.genesis import create_valid_beacon_state
+
+FORK_EPOCH = 2
+_UPGRADE_FN = {ALTAIR: "upgrade_to_altair", BELLATRIX: "upgrade_to_bellatrix"}
+_FORK_EPOCH_KEY = {ALTAIR: "ALTAIR_FORK_EPOCH", BELLATRIX: "BELLATRIX_FORK_EPOCH"}
+
+
+def _overridden_specs(pre_fork, post_fork, preset):
+    overrides = {_FORK_EPOCH_KEY[post_fork]: FORK_EPOCH}
+    return (
+        build_spec(pre_fork, preset, config_overrides=overrides),
+        build_spec(post_fork, preset, config_overrides=overrides),
+    )
+
+
+def _run_transition(spec, post_spec, post_fork, blocks_before=1, blocks_after=1):
+    state = create_valid_beacon_state(spec)
+    yield "pre", state.copy()
+
+    blocks = []
+    # pre-fork blocks, stopping short of the fork boundary
+    fork_slot = FORK_EPOCH * int(spec.SLOTS_PER_EPOCH)
+    for _ in range(blocks_before):
+        assert int(state.slot) + 1 < fork_slot, "scenario leaves no pre-fork room"
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    fork_block_index = len(blocks) - 1 if blocks else None
+
+    # advance to the boundary with the pre-fork spec, then upgrade
+    spec.process_slots(state, spec.Slot(fork_slot))
+    state = getattr(post_spec, _UPGRADE_FN[post_fork])(state)
+    assert state.fork.current_version == getattr(
+        post_spec.config, f"{post_fork.upper()}_FORK_VERSION"
+    )
+
+    # post-fork blocks under the new spec
+    for _ in range(blocks_after):
+        block = build_empty_block_for_next_slot(post_spec, state)
+        blocks.append(state_transition_and_sign_block(post_spec, state, block))
+
+    meta = {
+        "post_fork": post_fork,
+        "fork_epoch": FORK_EPOCH,
+        "blocks_count": len(blocks),
+    }
+    if fork_block_index is not None:
+        meta["fork_block"] = fork_block_index
+    yield "meta", "meta", meta
+    for i, b in enumerate(blocks):
+        yield f"blocks_{i}", b
+    yield "post", state.copy()
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_to_altair_empty_boundary(spec, state=None, phases=None):
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    yield from _run_transition(pre, post, ALTAIR, blocks_before=0, blocks_after=1)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_to_altair_with_blocks(spec, state=None, phases=None):
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    yield from _run_transition(pre, post, ALTAIR, blocks_before=2, blocks_after=2)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_transition_to_bellatrix_with_blocks(spec, state=None, phases=None):
+    pre, post = _overridden_specs(ALTAIR, BELLATRIX, spec.preset_name)
+    yield from _run_transition(pre, post, BELLATRIX, blocks_before=2, blocks_after=2)

@@ -108,9 +108,15 @@ def get_valid_attestations_at_slot(spec, state, slot, participation_fn=None, sig
 
 
 def state_transition_with_full_block(spec, state, fill_cur_epoch, fill_prev_epoch,
-                                     participation_fn=None, signed=False):
+                                     participation_fn=None, signed=None):
     """Build, apply, and return a signed block carrying the attestations the
-    caller asked for (reference parity: attestations.py's same-named helper)."""
+    caller asked for (reference parity: attestations.py's same-named helper).
+
+    signed=None follows the ambient BLS switch: when real signature checks
+    are on (generator mode), unsigned attestations would fail
+    is_valid_indexed_attestation inside process_attestation."""
+    if signed is None:
+        signed = bls.bls_active
     block = build_empty_block_for_next_slot(spec, state)
     if fill_cur_epoch and state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
         slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1

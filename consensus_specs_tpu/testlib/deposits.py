@@ -1,0 +1,79 @@
+"""Deposit scenario builders.
+
+Reference parity: test/helpers/deposits.py — construct signed DepositData,
+accumulate leaves in the incremental contract tree
+(utils/deposit_tree.DepositTree), and emit (Deposit, root) pairs whose
+depth-33 proofs satisfy process_deposit / initialize_beacon_state_from_eth1.
+"""
+from ..crypto import bls
+from ..utils.deposit_tree import DepositTree
+from .keys import get_pubkeys, privkeys
+
+
+def build_deposit_data(spec, pubkey, privkey, amount, withdrawal_credentials, signed=True):
+    data = spec.DepositData(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        msg = spec.DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=withdrawal_credentials, amount=amount
+        )
+        domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+        signing_root = spec.compute_signing_root(msg, domain)
+        data.signature = bls.Sign(privkey, bytes(signing_root))
+    return data
+
+
+def default_withdrawal_credentials(spec, validator_index: int) -> bytes:
+    return bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(get_pubkeys()[validator_index])[1:]
+
+
+def prepare_genesis_deposits(spec, count, amount=None, signed=True):
+    """count signed deposits with *progressive* proofs: deposit i's branch
+    verifies against the tree holding leaves 0..i — the root sequence
+    initialize_beacon_state_from_eth1 recomputes per deposit
+    (specs/phase0/beacon-chain.md genesis loop)."""
+    amount = amount if amount is not None else spec.MAX_EFFECTIVE_BALANCE
+    tree = DepositTree()
+    deposits = []
+    for i in range(count):
+        data = build_deposit_data(
+            spec,
+            get_pubkeys()[i],
+            privkeys[i],
+            amount,
+            default_withdrawal_credentials(spec, i),
+            signed=signed,
+        )
+        tree.push(bytes(spec.hash_tree_root(data)))
+        deposits.append(
+            spec.Deposit(proof=[spec.Bytes32(b) for b in tree.proof(i)], data=data)
+        )
+    return deposits, spec.Root(tree.root())
+
+
+def build_deposit_for_index(spec, state, validator_index, amount=None, signed=True):
+    """One post-genesis deposit appended to a tree seeded with the state's
+    existing deposit count (top-up when validator_index exists)."""
+    amount = amount if amount is not None else spec.MAX_EFFECTIVE_BALANCE
+    tree = DepositTree()
+    # replay placeholder leaves for already-consumed deposits so the index
+    # and proof line up with state.eth1_deposit_index
+    for i in range(int(state.eth1_deposit_index)):
+        tree.push(bytes(spec.hash_tree_root(spec.DepositData())))
+    data = build_deposit_data(
+        spec,
+        get_pubkeys()[validator_index],
+        privkeys[validator_index],
+        amount,
+        default_withdrawal_credentials(spec, validator_index),
+        signed=signed,
+    )
+    index = tree.deposit_count
+    tree.push(bytes(spec.hash_tree_root(data)))
+    deposit = spec.Deposit(proof=[spec.Bytes32(b) for b in tree.proof(index)], data=data)
+    state.eth1_data.deposit_root = spec.Root(tree.root())
+    state.eth1_data.deposit_count = tree.deposit_count
+    return deposit

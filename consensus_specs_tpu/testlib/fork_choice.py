@@ -1,0 +1,96 @@
+"""Step-yielding fork-choice scenario helpers.
+
+Reference parity: test/helpers/fork_choice.py (:26-48 tick_and_add_block) —
+drive one Store through scripted ticks/blocks/attestations while emitting
+the steps.yaml entries + ssz parts the fork_choice vector format requires
+(tests/formats/fork_choice: anchor_state, anchor_block, steps, per-object
+block_<root>/attestation_<root> files, `checks` steps with head/time/
+justified state).
+"""
+
+
+def get_genesis_forkchoice_store_and_block(spec, state):
+    assert state.slot == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    return spec.get_forkchoice_store(state, genesis_block), genesis_block
+
+
+def initialize_steps(spec, state):
+    """(store, anchor parts list, steps list) for a fresh scenario."""
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    parts = [("anchor_state", state.copy()), ("anchor_block", anchor_block)]
+    return store, parts, []
+
+
+def on_tick_step(spec, store, steps, time):
+    spec.on_tick(store, int(time))
+    steps.append({"tick": int(time)})
+
+
+def tick_to_slot_step(spec, store, steps, slot):
+    on_tick_step(spec, store, steps, store.genesis_time + int(slot) * int(spec.config.SECONDS_PER_SLOT))
+
+
+def add_block_step(spec, store, parts, steps, signed_block, valid=True):
+    root = spec.hash_tree_root(signed_block.message)
+    name = f"block_{bytes(root).hex()[:16]}"
+    parts.append((name, signed_block))
+    step = {"block": name}
+    if not valid:
+        step["valid"] = False
+        try:
+            spec.on_block(store, signed_block)
+        except AssertionError:
+            steps.append(step)
+            return None
+        raise AssertionError("expected on_block to reject")
+    spec.on_block(store, signed_block)
+    steps.append(step)
+    return root
+
+
+def add_attestation_step(spec, store, parts, steps, attestation, valid=True):
+    root = spec.hash_tree_root(attestation)
+    name = f"attestation_{bytes(root).hex()[:16]}"
+    parts.append((name, attestation))
+    step = {"attestation": name}
+    if not valid:
+        step["valid"] = False
+        try:
+            spec.on_attestation(store, attestation)
+        except AssertionError:
+            steps.append(step)
+            return
+        raise AssertionError("expected on_attestation to reject")
+    spec.on_attestation(store, attestation)
+    steps.append(step)
+
+
+def add_checks_step(spec, store, steps):
+    head = spec.get_head(store)
+    steps.append(
+        {
+            "checks": {
+                "time": int(store.time),
+                "head": {
+                    "slot": int(store.blocks[head].slot),
+                    "root": "0x" + bytes(head).hex(),
+                },
+                "justified_checkpoint": {
+                    "epoch": int(store.justified_checkpoint.epoch),
+                    "root": "0x" + bytes(store.justified_checkpoint.root).hex(),
+                },
+                "finalized_checkpoint": {
+                    "epoch": int(store.finalized_checkpoint.epoch),
+                    "root": "0x" + bytes(store.finalized_checkpoint.root).hex(),
+                },
+                "proposer_boost_root": "0x" + bytes(store.proposer_boost_root).hex(),
+            }
+        }
+    )
+    return head
+
+
+def finalize_steps(parts, steps):
+    """Order: anchor parts, object parts, then steps.yaml last."""
+    return parts + [("steps", "data", steps)]

@@ -1,0 +1,98 @@
+"""Randomized-scenario building blocks.
+
+Reference parity: test/utils/randomized_block_tests.py (randomize_state :52,
+transition_to_leaking, random_block_*) — the vocabulary the random-test
+codegen (generators/random/generate.py) composes into checked-in test files.
+Deterministic per (seed): every randomness source is an explicit
+random.Random so generated vectors are reproducible.
+"""
+from random import Random
+
+from .attestations import get_valid_attestation
+from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+from .state import next_epoch, next_slots
+
+
+def randomize_balances(spec, state, rng: Random):
+    for i in range(len(state.balances)):
+        roll = rng.random()
+        if roll < 0.1:
+            state.balances[i] = spec.Gwei(0)
+        elif roll < 0.3:
+            state.balances[i] = spec.Gwei(int(spec.config.EJECTION_BALANCE))
+        elif roll < 0.5:
+            state.balances[i] = spec.Gwei(rng.randrange(int(spec.MAX_EFFECTIVE_BALANCE)))
+
+
+def randomize_validator_flags(spec, state, rng: Random):
+    current = int(spec.get_current_epoch(state))
+    for v in state.validators:
+        roll = rng.random()
+        if roll < 0.1:
+            v.slashed = True
+        elif roll < 0.2 and current > 0:
+            v.exit_epoch = spec.Epoch(current + rng.randrange(1, 8))
+
+
+def randomize_state(spec, state, rng: Random):
+    randomize_balances(spec, state, rng)
+    randomize_validator_flags(spec, state, rng)
+    spec.process_effective_balance_updates(state)
+
+
+def transition_to_leaking(spec, state):
+    """Advance past MIN_EPOCHS_TO_INACTIVITY_PENALTY without participation."""
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+
+def random_slot_skips(spec, state, rng: Random):
+    next_slots(spec, state, rng.randrange(1, int(spec.SLOTS_PER_EPOCH)))
+
+
+def _advance_to_unslashed_proposer(spec, state):
+    """Randomization may slash upcoming proposers; a slashed proposer makes
+    every block at that slot invalid (process_block_header `assert not
+    proposer.slashed`), so hop slots until a buildable one (bounded)."""
+    for _ in range(2 * int(spec.SLOTS_PER_EPOCH)):
+        probe = state.copy()
+        spec.process_slots(probe, probe.slot + 1)
+        if not probe.validators[spec.get_beacon_proposer_index(probe)].slashed:
+            return
+        next_slots(spec, state, 1)
+    raise AssertionError("no unslashed proposer found in two epochs")
+
+
+def random_block(spec, state, rng: Random):
+    """An empty-ish block with a random sprinkle of valid attestations."""
+    _advance_to_unslashed_proposer(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    if int(state.slot) > int(spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        target = int(state.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        for _ in range(rng.randrange(0, 2)):
+            try:
+                att = get_valid_attestation(spec, state, slot=spec.Slot(target), signed=True)
+                block.body.attestations.append(att)
+            except Exception:
+                break
+    return block
+
+
+def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2):
+    """One composed scenario; yields the sanity-blocks vector parts."""
+    rng = Random(seed)
+    randomize_state(spec, state, rng)
+    if leak:
+        transition_to_leaking(spec, state)
+    if skips:
+        random_slot_skips(spec, state, rng)
+    yield "pre", state.copy()
+    signed = []
+    for _ in range(blocks):
+        block = random_block(spec, state, rng)
+        signed.append(state_transition_and_sign_block(spec, state, block))
+    yield "meta", "meta", {"blocks_count": len(signed)}
+    for i, s in enumerate(signed):
+        yield f"blocks_{i}", s
+    yield "post", state.copy()

@@ -34,6 +34,15 @@ def get_balance(state, index):
 
 def set_full_participation_previous_epoch(spec, state):
     """Make every active validator appear to have attested correctly for the
-    previous epoch (phase0: synthetic PendingAttestations)."""
-    from .attestations import add_attestations_for_epoch
-    add_attestations_for_epoch(spec, state, spec.get_previous_epoch(state))
+    previous epoch — phase0: synthetic PendingAttestations; altair family:
+    all three timely flags on the previous-epoch participation column."""
+    if hasattr(state, "previous_epoch_participation"):
+        full = spec.ParticipationFlags(0)
+        for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+            full = spec.add_flag(full, flag_index)
+        prev = spec.get_previous_epoch(state)
+        for index in spec.get_active_validator_indices(state, prev):
+            state.previous_epoch_participation[index] = full
+    else:
+        from .attestations import add_attestations_for_epoch
+        add_attestations_for_epoch(spec, state, spec.get_previous_epoch(state))

@@ -1,0 +1,107 @@
+"""Deposit-contract incremental Merkle tree (host tooling).
+
+Reference parity: the on-chain contract's algorithm
+(solidity_deposit_contract/deposit_contract.sol — `deposit()` :101 updates
+one branch node per insertion; `get_deposit_root()` :80 folds the branch
+against the zero-hash ladder and mixes in the little-endian deposit count)
+and its spec `specs/phase0/deposit-contract.md`. The EVM artifact itself is
+external to this framework; this module re-implements the data structure for
+genesis tooling and deposit-proof construction, matching
+`process_deposit`'s `is_valid_merkle_branch(leaf, proof,
+DEPOSIT_CONTRACT_TREE_DEPTH + 1, index, deposit_root)` check
+(specs/phase0/beacon-chain.md:1851) bit-for-bit.
+
+O(1) storage per insertion (the `branch` array holds one node per level —
+the root of the largest complete subtree left of the insertion frontier at
+that height), O(log n) per root read. Proof generation for arbitrary
+indices keeps the full leaf list (tooling only; the contract never needs
+proofs — clients build them from the log).
+"""
+from __future__ import annotations
+
+from .hash import hash_eth2 as sha256
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+def _zero_hashes(depth: int = DEPOSIT_CONTRACT_TREE_DEPTH) -> list[bytes]:
+    zh = [b"\x00" * 32]
+    for _ in range(depth - 1):
+        zh.append(sha256(zh[-1] + zh[-1]))
+    return zh
+
+
+ZERO_HASHES = _zero_hashes()
+
+
+class DepositTree:
+    """Incremental depth-32 Merkle accumulator with count mix-in."""
+
+    def __init__(self) -> None:
+        self.branch: list[bytes] = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        self.leaves: list[bytes] = []  # retained for proof tooling
+
+    @property
+    def deposit_count(self) -> int:
+        return len(self.leaves)
+
+    def push(self, leaf: bytes) -> None:
+        """Insert hash_tree_root(DepositData); one branch node changes."""
+        assert len(leaf) == 32
+        assert self.deposit_count < 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1, "tree full"
+        self.leaves.append(leaf)
+        size = self.deposit_count
+        node = leaf
+        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                self.branch[h] = node
+                return
+            node = sha256(self.branch[h] + node)
+            size >>= 1
+        raise AssertionError("unreachable: size bound checked above")
+
+    def root(self) -> bytes:
+        """`get_deposit_root()`: branch fold + little-endian count mix-in."""
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                node = sha256(self.branch[h] + node)
+            else:
+                node = sha256(node + ZERO_HASHES[h])
+            size >>= 1
+        return sha256(node + self.deposit_count.to_bytes(8, "little") + b"\x00" * 24)
+
+    def proof(self, index: int) -> list[bytes]:
+        """33-element branch for leaf `index` against the CURRENT root:
+        32 sibling hashes plus the count mix-in node, the exact shape
+        `process_deposit` verifies at depth DEPOSIT_CONTRACT_TREE_DEPTH + 1."""
+        assert 0 <= index < self.deposit_count
+        # level 0 = padded leaves; level h nodes pair into level h+1
+        level = list(self.leaves)
+        proof: list[bytes] = []
+        idx = index
+        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            sibling = idx ^ 1
+            proof.append(level[sibling] if sibling < len(level) else ZERO_HASHES[h])
+            nxt = []
+            for i in range(0, len(level), 2):
+                left = level[i]
+                right = level[i + 1] if i + 1 < len(level) else ZERO_HASHES[h]
+                nxt.append(sha256(left + right))
+            level = nxt or [ZERO_HASHES[h]]
+            idx >>= 1
+        proof.append(self.deposit_count.to_bytes(8, "little") + b"\x00" * 24)
+        return proof
+
+
+def is_valid_deposit_proof(leaf: bytes, proof: list[bytes], index: int, root: bytes) -> bool:
+    """Standalone `is_valid_merkle_branch` at depth 33 (for tests/tooling;
+    the compiled specs carry their own copy)."""
+    value = leaf
+    for i, node in enumerate(proof):
+        if (index >> i) & 1:
+            value = sha256(node + value)
+        else:
+            value = sha256(value + node)
+    return value == root

@@ -1,0 +1,196 @@
+"""BLS test-vector generator: hand-written cases incl. edge conditions.
+
+Reference parity: tests/generators/bls/main.py (~550 LoC) — vectors for
+Sign / Verify / Aggregate / AggregateVerify / FastAggregateVerify /
+eth-extension behaviors, with the consensus-critical edge cases: the zero
+privkey is invalid, the infinity pubkey/signature must be rejected by
+Verify-family calls, empty aggregation input is an error,
+eth_fast_aggregate_verify accepts (no pubkeys, infinity sig).
+
+Format (tests/formats/bls): one data.yaml per case with {input, output}.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.crypto import bls_sig
+from consensus_specs_tpu.crypto.bls12_381 import R as CURVE_ORDER
+from consensus_specs_tpu.gen import TestCase, TestProvider
+from consensus_specs_tpu.gen.gen_runner import run_generator
+
+PRIVKEYS = [
+    1,
+    42,
+    2**32 - 1,
+    CURVE_ORDER - 1,
+    int.from_bytes(b"\x12" * 32, "big") % CURVE_ORDER,
+]
+MESSAGES = [b"\x00" * 32, b"\xab" * 32, b"consensus-specs-tpu bls vectors!"]
+
+Z1_PUBKEY = b"\xc0" + b"\x00" * 47  # infinity G1, compressed
+Z2_SIGNATURE = b"\xc0" + b"\x00" * 95  # infinity G2, compressed
+
+
+def hexify(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _case(handler, name, data):
+    return TestCase(
+        fork_name="general",
+        preset_name="general",
+        runner_name="bls",
+        handler_name=handler,
+        suite_name="bls",
+        case_name=name,
+        case_fn=lambda data=data: [("data", "data", data)],
+    )
+
+
+def sign_cases():
+    for i, sk in enumerate(PRIVKEYS):
+        for j, msg in enumerate(MESSAGES):
+            sig = bls_sig.Sign(sk, msg)
+            yield _case(
+                "sign",
+                f"sign_case_{i}_{j}",
+                {
+                    "input": {"privkey": hexify(sk.to_bytes(32, "big")), "message": hexify(msg)},
+                    "output": hexify(sig),
+                },
+            )
+    # the zero privkey is not a valid BLS secret: expect null output
+    yield _case(
+        "sign",
+        "sign_case_zero_privkey",
+        {"input": {"privkey": hexify(b"\x00" * 32), "message": hexify(MESSAGES[0])}, "output": None},
+    )
+
+
+def verify_cases():
+    sk, msg = PRIVKEYS[1], MESSAGES[1]
+    pk = bls_sig.SkToPk(sk)
+    sig = bls_sig.Sign(sk, msg)
+    good = {"pubkey": hexify(pk), "message": hexify(msg), "signature": hexify(sig)}
+    yield _case("verify", "verify_valid", {"input": good, "output": True})
+    yield _case(
+        "verify",
+        "verify_wrong_message",
+        {"input": {**good, "message": hexify(MESSAGES[0])}, "output": False},
+    )
+    wrong_sig = bls_sig.Sign(PRIVKEYS[0], msg)
+    yield _case(
+        "verify",
+        "verify_wrong_signer",
+        {"input": {**good, "signature": hexify(wrong_sig)}, "output": False},
+    )
+    yield _case(
+        "verify",
+        "verify_tampered_signature",
+        {"input": {**good, "signature": hexify(b"\xff" * 96)}, "output": False},
+    )
+    # infinity pubkey / infinity signature must both be rejected
+    yield _case(
+        "verify",
+        "verify_infinity_pubkey",
+        {
+            "input": {"pubkey": hexify(Z1_PUBKEY), "message": hexify(msg), "signature": hexify(Z2_SIGNATURE)},
+            "output": False,
+        },
+    )
+    yield _case(
+        "verify",
+        "verify_infinity_signature",
+        {"input": {**good, "signature": hexify(Z2_SIGNATURE)}, "output": False},
+    )
+
+
+def aggregate_cases():
+    msg = MESSAGES[2]
+    sigs = [bls_sig.Sign(sk, msg) for sk in PRIVKEYS[:3]]
+    agg = bls_sig.Aggregate(sigs)
+    yield _case(
+        "aggregate",
+        "aggregate_3_signatures",
+        {"input": [hexify(s) for s in sigs], "output": hexify(agg)},
+    )
+    yield _case(
+        "aggregate",
+        "aggregate_single",
+        {"input": [hexify(sigs[0])], "output": hexify(sigs[0])},
+    )
+    # empty input is an error (reference returns null output)
+    yield _case("aggregate", "aggregate_empty", {"input": [], "output": None})
+    yield _case(
+        "aggregate",
+        "aggregate_infinity",
+        {"input": [hexify(Z2_SIGNATURE), hexify(Z2_SIGNATURE)], "output": hexify(Z2_SIGNATURE)},
+    )
+
+
+def aggregate_verify_cases():
+    pairs = list(zip(PRIVKEYS[:3], MESSAGES))
+    pks = [bls_sig.SkToPk(sk) for sk, _ in pairs]
+    sig = bls_sig.Aggregate([bls_sig.Sign(sk, m) for sk, m in pairs])
+    good = {
+        "pubkeys": [hexify(pk) for pk in pks],
+        "messages": [hexify(m) for _, m in pairs],
+        "signature": hexify(sig),
+    }
+    yield _case("aggregate_verify", "aggregate_verify_valid", {"input": good, "output": True})
+    shuffled = dict(good, messages=list(reversed(good["messages"])))
+    yield _case("aggregate_verify", "aggregate_verify_wrong_order", {"input": shuffled, "output": False})
+    yield _case(
+        "aggregate_verify",
+        "aggregate_verify_infinity_pubkey",
+        {
+            "input": {**good, "pubkeys": good["pubkeys"][:2] + [hexify(Z1_PUBKEY)]},
+            "output": False,
+        },
+    )
+    yield _case(
+        "aggregate_verify",
+        "aggregate_verify_empty",
+        {"input": {"pubkeys": [], "messages": [], "signature": hexify(Z2_SIGNATURE)}, "output": False},
+    )
+
+
+def fast_aggregate_verify_cases():
+    msg = MESSAGES[0]
+    sks = PRIVKEYS[:4]
+    pks = [bls_sig.SkToPk(sk) for sk in sks]
+    sig = bls_sig.Aggregate([bls_sig.Sign(sk, msg) for sk in sks])
+    good = {"pubkeys": [hexify(pk) for pk in pks], "message": hexify(msg), "signature": hexify(sig)}
+    yield _case("fast_aggregate_verify", "fast_aggregate_verify_valid", {"input": good, "output": True})
+    yield _case(
+        "fast_aggregate_verify",
+        "fast_aggregate_verify_extra_pubkey",
+        {
+            "input": {**good, "pubkeys": good["pubkeys"] + [hexify(bls_sig.SkToPk(PRIVKEYS[4]))]},
+            "output": False,
+        },
+    )
+    yield _case(
+        "fast_aggregate_verify",
+        "fast_aggregate_verify_empty_pubkeys",
+        {"input": {**good, "pubkeys": []}, "output": False},
+    )
+    yield _case(
+        "fast_aggregate_verify",
+        "fast_aggregate_verify_infinity_signature",
+        {"input": {**good, "signature": hexify(Z2_SIGNATURE)}, "output": False},
+    )
+
+
+def make_cases():
+    yield from sign_cases()
+    yield from verify_cases()
+    yield from aggregate_cases()
+    yield from aggregate_verify_cases()
+    yield from fast_aggregate_verify_cases()
+
+
+if __name__ == "__main__":
+    raise SystemExit(run_generator("bls", [TestProvider(make_cases=make_cases)]))

@@ -4,6 +4,11 @@ Reference parity: tests/generators/epoch_processing/main.py — maps fork ->
 dual-mode test modules and runs them through the generator runtime.
 Usage: python main.py -o <output_dir> [--preset-list minimal]
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
 from consensus_specs_tpu.gen import run_state_test_generators
 
 from consensus_specs_tpu.spec_tests import epoch_processing as ep

@@ -1,0 +1,21 @@
+"""Finality vector generator.
+
+Reference parity: tests/generators/finality/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import finality
+
+ALL_MODS = {
+    "phase0": {"finality": finality},
+    "altair": {"finality": finality},
+    "bellatrix": {"finality": finality},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("finality", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,28 @@
+"""Fork-choice vector generator (scripted store scenarios, steps.yaml).
+
+Reference parity: tests/generators/fork_choice/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import fork_choice
+
+_HANDLERS = {
+    "get_head": (fork_choice, "genesis_head"),
+    "on_block": (fork_choice, "on_block"),
+    "ex_ante": (fork_choice, "proposer_boost"),
+    "on_attestation": (fork_choice, "on_attestation"),
+    "chain": (fork_choice, "chain"),
+}
+ALL_MODS = {
+    "phase0": _HANDLERS,
+    "altair": _HANDLERS,
+    "bellatrix": _HANDLERS,
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("fork_choice", ALL_MODS, presets=("minimal",))

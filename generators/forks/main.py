@@ -1,0 +1,20 @@
+"""Fork-upgrade vector generator (upgrade_to_<fork> pre/post states).
+
+Reference parity: tests/generators/forks/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import forks
+
+ALL_MODS = {
+    "phase0": {"fork": forks},
+    "altair": {"fork": forks},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("forks", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,22 @@
+"""Genesis vector generator (initialization + validity).
+
+Reference parity: tests/generators/genesis/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import genesis
+
+ALL_MODS = {
+    "phase0": {
+        "initialization": (genesis, "initialize_"),
+        "validity": (genesis, "validity_"),
+    }
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("genesis", ALL_MODS, presets=("minimal",))

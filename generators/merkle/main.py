@@ -1,0 +1,65 @@
+"""Merkle single-proof vector generator.
+
+Reference parity: tests/generators/merkle/main.py + tests/formats/merkle —
+a BeaconState object plus (leaf, leaf_index, branch) proofs that clients
+verify with is_valid_merkle_branch / calculate_merkle_root. Proofs are
+built over the altair state for the light-client-critical gindices
+(finalized_checkpoint.root = 105, next_sync_committee = 55,
+current_sync_committee = 54).
+
+Usage: python main.py -o <output_dir> [--preset-list minimal]
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.gen import TestCase, TestProvider
+from consensus_specs_tpu.gen.gen_runner import run_generator
+from consensus_specs_tpu.ssz import serialize
+from consensus_specs_tpu.ssz.gindex import get_generalized_index
+from consensus_specs_tpu.ssz.proofs import build_proof, get_subtree_node_root
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+
+
+def make_cases():
+    spec = get_spec("altair", "minimal")
+    state = create_valid_beacon_state(spec, num_validators=32)
+    paths = {
+        "finalized_root": ("finalized_checkpoint", "root"),
+        "current_sync_committee": ("current_sync_committee",),
+        "next_sync_committee": ("next_sync_committee",),
+    }
+    for name, path in paths.items():
+        gindex = get_generalized_index(type(state), *path)
+
+        def case_fn(state=state, gindex=gindex):
+            branch = build_proof(state, gindex)
+            leaf = get_subtree_node_root(state, gindex)
+            return [
+                ("object", "ssz", serialize(state)),
+                (
+                    "proof",
+                    "data",
+                    {
+                        "leaf": "0x" + leaf.hex(),
+                        "leaf_index": int(gindex),
+                        "branch": ["0x" + b.hex() for b in branch],
+                    },
+                ),
+            ]
+
+        yield TestCase(
+            fork_name="altair",
+            preset_name="minimal",
+            runner_name="merkle",
+            handler_name="single_proof",
+            suite_name="pyspec_tests",
+            case_name=f"{name}_merkle_proof",
+            case_fn=case_fn,
+        )
+
+
+if __name__ == "__main__":
+    raise SystemExit(run_generator("merkle", [TestProvider(make_cases=make_cases)]))

@@ -3,6 +3,11 @@
 Reference parity: tests/generators/operations/main.py.
 Usage: python main.py -o <output_dir> [--preset-list minimal]
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
 from consensus_specs_tpu.gen import run_state_test_generators
 
 from consensus_specs_tpu.spec_tests import operations as ops

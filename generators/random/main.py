@@ -1,0 +1,23 @@
+"""Random-scenario vector generator (runs the CODEGEN'd test module).
+
+Reference parity: tests/generators/random/main.py — replays the generated
+random test matrix (see generate.py in this directory) as sanity-blocks
+vectors.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import random_gen
+
+ALL_MODS = {
+    "phase0": {"random": random_gen},
+    "altair": {"random": random_gen},
+    "bellatrix": {"random": random_gen},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("random", ALL_MODS, presets=("minimal",))

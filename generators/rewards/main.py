@@ -1,0 +1,21 @@
+"""Rewards vector generator (per-component Deltas).
+
+Reference parity: tests/generators/rewards/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import rewards
+
+ALL_MODS = {
+    "phase0": {"basic": rewards},
+    "altair": {"basic": rewards},
+    "bellatrix": {"basic": rewards},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("rewards", ALL_MODS, presets=("minimal",))

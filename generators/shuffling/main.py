@@ -6,6 +6,11 @@ replay against their shuffle implementation. The mapping comes from the
 batched device kernel (ops/shuffle.py), which the test suite has already
 differentially validated against the scalar spec.
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
 from consensus_specs_tpu.compiler import get_spec
 from consensus_specs_tpu.gen import TestCase, TestProvider
 from consensus_specs_tpu.gen.gen_runner import run_generator

@@ -6,6 +6,11 @@ instances as {roots.yaml (hash_tree_root), serialized.ssz_snappy,
 value.yaml (debug encoding)} across the randomization modes of
 debug/random_value.py.
 """
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
 from random import Random
 
 from consensus_specs_tpu.compiler import get_spec

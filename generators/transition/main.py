@@ -1,0 +1,20 @@
+"""Cross-fork transition vector generator.
+
+Reference parity: tests/generators/transition/main.py.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.gen import run_state_test_generators
+from consensus_specs_tpu.spec_tests import transition
+
+ALL_MODS = {
+    "phase0": {"core": transition},
+    "altair": {"core": transition},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("transition", ALL_MODS, presets=("minimal",))

@@ -1,0 +1,91 @@
+"""Deposit-contract incremental Merkle tree vs batch tree and the spec.
+
+Parity: solidity_deposit_contract/deposit_contract.sol deposit()/
+get_deposit_root() semantics and process_deposit's depth-33 branch check
+(specs/phase0/beacon-chain.md:1851)."""
+import hashlib
+
+import pytest
+
+from consensus_specs_tpu.utils.deposit_tree import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    ZERO_HASHES,
+    DepositTree,
+    is_valid_deposit_proof,
+)
+
+
+def h(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+def leaf(i: int) -> bytes:
+    return h(b"deposit-leaf-%d" % i)
+
+
+def batch_root(leaves):
+    """Independent O(n log n) oracle: full padded tree + count mix-in."""
+    level = list(leaves)
+    for depth in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+        if len(level) % 2:
+            level.append(ZERO_HASHES[depth])
+        level = [h(level[i] + level[i + 1]) for i in range(0, len(level), 2)]
+        if not level:
+            level = [ZERO_HASHES[depth + 1] if depth + 1 < len(ZERO_HASHES) else h(ZERO_HASHES[depth] + ZERO_HASHES[depth])]
+    return h(level[0] + len(leaves).to_bytes(8, "little") + b"\x00" * 24)
+
+
+def test_empty_root():
+    assert DepositTree().root() == batch_root([])
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 33])
+def test_incremental_matches_batch(n):
+    t = DepositTree()
+    for i in range(n):
+        t.push(leaf(i))
+    assert t.root() == batch_root([leaf(i) for i in range(n)])
+
+
+def test_root_changes_per_push():
+    t = DepositTree()
+    seen = {t.root()}
+    for i in range(10):
+        t.push(leaf(i))
+        r = t.root()
+        assert r not in seen
+        seen.add(r)
+
+
+def test_proofs_verify_and_bind():
+    t = DepositTree()
+    for i in range(9):
+        t.push(leaf(i))
+    root = t.root()
+    for i in range(9):
+        proof = t.proof(i)
+        assert len(proof) == DEPOSIT_CONTRACT_TREE_DEPTH + 1
+        assert is_valid_deposit_proof(leaf(i), proof, i, root)
+        # wrong index / wrong leaf / wrong root all fail
+        assert not is_valid_deposit_proof(leaf(i), proof, i + 1, root)
+        assert not is_valid_deposit_proof(leaf(i + 1 if i + 1 < 9 else 0), proof, i, root)
+
+
+def test_proof_against_spec_process_deposit():
+    """End-to-end: a proof built here passes the compiled spec's
+    is_valid_merkle_branch at depth 33 (the process_deposit check)."""
+    from consensus_specs_tpu.compiler import get_spec
+
+    spec = get_spec("phase0", "minimal")
+    t = DepositTree()
+    for i in range(4):
+        t.push(leaf(i))
+    root = t.root()
+    for i in range(4):
+        assert spec.is_valid_merkle_branch(
+            leaf=spec.Bytes32(leaf(i)),
+            branch=[spec.Bytes32(x) for x in t.proof(i)],
+            depth=spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            index=i,
+            root=spec.Bytes32(root),
+        )

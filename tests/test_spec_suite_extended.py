@@ -1,0 +1,28 @@
+"""Collect the widened dual-mode conformance suite under pytest.
+
+Same mechanism as test_spec_suite.py: each imported name is a
+decorator-wrapped dual-mode test body that pytest calls with no arguments
+(all selected forks, minimal preset, BLS stubbed for speed). Covers the
+second wave of suites: genesis, finality, rewards, fork upgrades,
+cross-fork transitions, fork choice, and the codegen'd random matrix.
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+
+
+@pytest.fixture(autouse=True)
+def _fast_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+from consensus_specs_tpu.spec_tests.finality import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.fork_choice import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.forks import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.genesis import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.random_gen import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.rewards import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.transition import *  # noqa: E402,F401,F403
