@@ -1,0 +1,150 @@
+// Batched SHA-256 / Merkle-level engine (host-side fast path).
+//
+// Role: the native analog of the reference's pycryptodome C sha256
+// (SURVEY.md §2.2) for the HOST side of Merkleization — hashing sibling
+// pairs level-by-level (the dominant host cost of hash_tree_root) without
+// per-call Python/hashlib overhead. The DEVICE path is ops/sha256_jax.py;
+// this engine covers control-flow-heavy host hashing (SSZ trees, deposit
+// trees, proof folding) where kernel launches don't pay.
+//
+// Self-contained SHA-256 (FIPS 180-4), no external deps. Exposed C ABI:
+//   hashtree_sha256(in, len, out32)                 one-shot digest
+//   hashtree_hash_pairs(in, n, out)                 n x 64B -> n x 32B
+//   hashtree_merkle_root(leaves, n, depth, out32)   padded-tree root via
+//                                                   zero-hash ladder
+// All loops are cache-friendly sequential passes; hash_pairs is the API the
+// Python binding batches whole tree levels through.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t load_be(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) | (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+inline void store_be(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v >> 24); p[1] = uint8_t(v >> 16); p[2] = uint8_t(v >> 8); p[3] = uint8_t(v);
+}
+
+void compress(uint32_t state[8], const uint8_t block[64]) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) w[i] = load_be(block + 4 * i);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + s1 + ch + K[i] + w[i];
+    uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = s0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+// Digest of exactly one 64-byte input (the Merkle pair case): one data
+// block plus one constant padding block (0x80, zeros, bit-length 512).
+void sha256_64(const uint8_t in[64], uint8_t out[32]) {
+  uint32_t st[8];
+  std::memcpy(st, H0, sizeof st);
+  compress(st, in);
+  uint8_t pad[64] = {0};
+  pad[0] = 0x80;
+  pad[62] = 0x02;  // 512 bits, big-endian in the last 8 bytes
+  compress(st, pad);
+  for (int i = 0; i < 8; i++) store_be(out + 4 * i, st[i]);
+}
+
+void sha256_any(const uint8_t* in, size_t len, uint8_t* out) {
+  uint32_t st[8];
+  std::memcpy(st, H0, sizeof st);
+  size_t off = 0;
+  for (; off + 64 <= len; off += 64) compress(st, in + off);
+  uint8_t tail[128] = {0};
+  size_t rem = len - off;
+  std::memcpy(tail, in + off, rem);
+  tail[rem] = 0x80;
+  size_t tail_blocks = (rem + 1 + 8 <= 64) ? 1 : 2;
+  uint64_t bits = uint64_t(len) * 8;
+  uint8_t* lenp = tail + tail_blocks * 64 - 8;
+  for (int i = 0; i < 8; i++) lenp[i] = uint8_t(bits >> (56 - 8 * i));
+  compress(st, tail);
+  if (tail_blocks == 2) compress(st, tail + 64);
+  for (int i = 0; i < 8; i++) store_be(out + 4 * i, st[i]);
+}
+
+}  // namespace
+
+extern "C" {
+
+void hashtree_sha256(const uint8_t* in, size_t len, uint8_t* out32) {
+  sha256_any(in, len, out32);
+}
+
+// n sibling pairs (n * 64 bytes contiguous) -> n parents (n * 32 bytes).
+void hashtree_hash_pairs(const uint8_t* in, size_t n, uint8_t* out) {
+  for (size_t i = 0; i < n; i++) sha256_64(in + 64 * i, out + 32 * i);
+}
+
+// Root of the binary tree over `n` 32-byte leaves padded with zero-subtrees
+// to 2^depth leaves. Scratch is a running level buffer (caller-independent).
+long hashtree_merkle_root(const uint8_t* leaves, size_t n, size_t depth, uint8_t* out32) {
+  if (n > (depth >= 48 ? ~size_t(0) : (size_t(1) << depth))) return -1;
+  // zero-hash ladder
+  uint8_t zero[64][32];
+  std::memset(zero[0], 0, 32);
+  for (size_t h = 0; h + 1 <= depth && h < 63; h++) {
+    uint8_t pair[64];
+    std::memcpy(pair, zero[h], 32);
+    std::memcpy(pair + 32, zero[h], 32);
+    sha256_64(pair, zero[h + 1]);
+  }
+  if (n == 0) {
+    std::memcpy(out32, zero[depth], 32);
+    return 0;
+  }
+  // level-by-level reduction in place
+  uint8_t* buf = new uint8_t[((n + 1) / 2 * 2) * 32];
+  std::memcpy(buf, leaves, n * 32);
+  size_t count = n;
+  for (size_t h = 0; h < depth; h++) {
+    if (count & 1) {
+      std::memcpy(buf + count * 32, zero[h], 32);
+      count++;
+    }
+    hashtree_hash_pairs(buf, count / 2, buf);
+    count /= 2;
+  }
+  std::memcpy(out32, buf, 32);
+  delete[] buf;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,112 @@
+"""Native batched sha256/Merkle host engine: ctypes over hashtree.cpp.
+
+Fast path for host-side tree hashing (SSZ hash_tree_root levels, deposit
+trees, proof folding); falls back to hashlib when the toolchain is missing.
+Role parity: the reference's pycryptodome C sha256 dependency
+(setup.py:1017) — but batched at the tree-level granularity instead of
+per-call. Device-side batching lives in ops/sha256_jax.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "hashtree.cpp"
+_LIB = _HERE / "_hashtree.so"
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _load():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(str(_LIB))
+            lib.hashtree_sha256.restype = None
+            lib.hashtree_sha256.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+            lib.hashtree_hash_pairs.restype = None
+            lib.hashtree_hash_pairs.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+            lib.hashtree_merkle_root.restype = ctypes.c_long
+            lib.hashtree_merkle_root.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def sha256(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return hashlib.sha256(data).digest()
+    out = ctypes.create_string_buffer(32)
+    lib.hashtree_sha256(data, len(data), out)
+    return out.raw
+
+
+def hash_pairs(level: bytes) -> bytes:
+    """One Merkle level: concatenated sibling pairs (k*64 bytes) -> parents
+    (k*32 bytes). THE hot host call — one C roundtrip per tree level."""
+    assert len(level) % 64 == 0
+    n = len(level) // 64
+    lib = _load()
+    if lib is None:
+        return b"".join(
+            hashlib.sha256(level[64 * i : 64 * (i + 1)]).digest() for i in range(n)
+        )
+    out = ctypes.create_string_buffer(32 * n)
+    lib.hashtree_hash_pairs(level, n, out)
+    return out.raw
+
+
+def merkle_root(leaves: bytes, depth: int) -> bytes:
+    """Root over len/32 leaves padded with zero-subtrees to 2^depth."""
+    assert len(leaves) % 32 == 0
+    n = len(leaves) // 32
+    lib = _load()
+    if lib is None:
+        return _py_merkle_root(leaves, n, depth)
+    out = ctypes.create_string_buffer(32)
+    rc = lib.hashtree_merkle_root(leaves, n, depth, out)
+    if rc != 0:
+        raise ValueError("leaf count exceeds 2^depth")
+    return out.raw
+
+
+def _py_merkle_root(leaves: bytes, n: int, depth: int) -> bytes:
+    zero = b"\x00" * 32
+    zeros = [zero]
+    for _ in range(depth):
+        zeros.append(hashlib.sha256(zeros[-1] + zeros[-1]).digest())
+    if n > (1 << depth):
+        raise ValueError("leaf count exceeds 2^depth")
+    level = [leaves[32 * i : 32 * (i + 1)] for i in range(n)]
+    if not level:
+        return zeros[depth]
+    for h in range(depth):
+        if len(level) % 2:
+            level.append(zeros[h])
+        level = [
+            hashlib.sha256(level[i] + level[i + 1]).digest() for i in range(0, len(level), 2)
+        ]
+    return level[0]

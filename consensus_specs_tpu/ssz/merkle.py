@@ -13,6 +13,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..native import hashtree as _native
 from ..ops.sha256_np import sha256_64B
 from ..utils.hash import hash_eth2
 
@@ -33,11 +34,18 @@ def next_power_of_two(n: int) -> int:
 
 def hash_level(level: Sequence[bytes], depth: int) -> list[bytes]:
     """Hash one level of 32-byte nodes into parents; odd tail is padded with
-    the zero-subtree root for `depth` (the level's height above the leaves)."""
+    the zero-subtree root for `depth` (the level's height above the leaves).
+
+    Dispatch, fastest available first: the native C++ engine
+    (native/hashtree.cpp, one ctypes roundtrip per level), the vectorized
+    numpy kernel, then per-pair hashlib."""
     n = len(level)
     if n % 2 == 1:
         level = list(level) + [zerohashes[depth]]
         n += 1
+    if n >= 4 and _native.available():
+        out = _native.hash_pairs(b"".join(level))
+        return [out[32 * i : 32 * (i + 1)] for i in range(n // 2)]
     if n >= _NP_BATCH_MIN:
         arr = np.frombuffer(b"".join(level), dtype=np.uint8).reshape(n // 2, 64)
         out = sha256_64B(arr)

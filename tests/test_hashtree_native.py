@@ -1,0 +1,59 @@
+"""Native C++ hashtree engine vs hashlib oracle (differential)."""
+import hashlib
+import random
+
+import pytest
+
+from consensus_specs_tpu.native import hashtree
+
+rng = random.Random(0x5A)
+
+
+def test_native_available():
+    # the toolchain is baked into the image; absence means a build break
+    assert hashtree.available()
+
+
+@pytest.mark.parametrize("n", [0, 1, 3, 55, 56, 63, 64, 65, 127, 128, 1000])
+def test_sha256_matches_hashlib(n):
+    data = bytes(rng.randrange(256) for _ in range(n))
+    assert hashtree.sha256(data) == hashlib.sha256(data).digest()
+
+
+@pytest.mark.parametrize("pairs", [1, 2, 7, 64])
+def test_hash_pairs_matches_hashlib(pairs):
+    level = bytes(rng.randrange(256) for _ in range(64 * pairs))
+    got = hashtree.hash_pairs(level)
+    want = b"".join(
+        hashlib.sha256(level[64 * i : 64 * (i + 1)]).digest() for i in range(pairs)
+    )
+    assert got == want
+
+
+@pytest.mark.parametrize("n,depth", [(0, 5), (1, 5), (2, 5), (5, 5), (32, 5), (9, 10)])
+def test_merkle_root_matches_python(n, depth):
+    leaves = bytes(rng.randrange(256) for _ in range(32 * n))
+    assert hashtree.merkle_root(leaves, depth) == hashtree._py_merkle_root(leaves, n, depth)
+
+
+def test_merkle_root_matches_ssz_merkleize():
+    """Cross-check against the SSZ engine's chunk merkleization."""
+    from consensus_specs_tpu.ssz.merkle import merkleize_chunks
+
+    chunks = [bytes([i]) * 32 for i in range(7)]
+    got = hashtree.merkle_root(b"".join(chunks), 3)
+    assert got == merkleize_chunks(chunks, limit=8)
+
+
+def test_merkle_root_rejects_overflow():
+    with pytest.raises(ValueError):
+        hashtree.merkle_root(b"\x00" * 32 * 3, 1)
+
+
+def test_empty_tree_root_is_zero_ladder():
+    import hashlib as h
+
+    z = b"\x00" * 32
+    for _ in range(4):
+        z = h.sha256(z + z).digest()
+    assert hashtree.merkle_root(b"", 4) == z
