@@ -9,17 +9,23 @@ test_process_voluntary_exit.py (success + representative invalid cases).
 """
 from ..testlib.attestations import get_valid_attestation, sign_attestation
 from ..testlib.context import (
+    ALTAIR,
+    BELLATRIX,
     always_bls,
     expect_assertion_error,
     spec_state_test,
     with_all_phases,
+    with_phases,
 )
 from ..testlib.state import next_epoch, next_slots, transition_to
 
 
-def _run_op(spec, state, name, operation, valid=True):
+def _run_op(spec, state, name, operation, valid=True, part_name=None):
+    """part_name overrides the emitted vector file name when the reference
+    format differs from the process_* suffix (block_header cases are written
+    as block.ssz_snappy, tests/formats/operations)."""
     yield "pre", state.copy()
-    yield name, operation
+    yield part_name or name, operation
     process = getattr(spec, f"process_{name}")
     if not valid:
         expect_assertion_error(lambda: process(state, operation))
@@ -116,3 +122,225 @@ def test_voluntary_exit_double_exit(spec, state):
     signed_exit = _build_voluntary_exit(spec, state, 0)
     spec.process_voluntary_exit(state, signed_exit)
     yield from _run_op(spec, state, "voluntary_exit", signed_exit, valid=False)
+
+
+# --- proposer slashings (test/phase0/block_processing/test_process_proposer_slashing.py)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_success(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    index = slashing.signed_header_1.message.proposer_index
+    yield from _run_op(spec, state, "proposer_slashing", slashing)
+    assert state.validators[index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_identical_headers(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    slashing.signed_header_2 = slashing.signed_header_1
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_already_slashed(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    index = slashing.signed_header_1.message.proposer_index
+    spec.process_proposer_slashing(state, slashing)
+    assert state.validators[index].slashed
+    repeat = build_proposer_slashing(spec, state, proposer_index=index)
+    yield from _run_op(spec, state, "proposer_slashing", repeat, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_proposer_slashing_invalid_signature_1(spec, state):
+    from ..testlib.slashings import build_proposer_slashing, sign_block_header
+    from ..testlib.keys import privkeys
+
+    slashing = build_proposer_slashing(spec, state)
+    # re-sign header 1 with a key guaranteed to differ from the proposer's
+    proposer_index = int(slashing.signed_header_1.message.proposer_index)
+    wrong = sign_block_header(
+        spec, state, slashing.signed_header_1.message,
+        privkeys[(proposer_index + 1) % len(privkeys)],
+    )
+    slashing.signed_header_1 = wrong
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+# --- attester slashings (test_process_attester_slashing.py)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_double_vote(spec, state):
+    from ..testlib.slashings import build_attester_slashing
+
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    slashing = build_attester_slashing(spec, state)
+    indices = set(slashing.attestation_1.attesting_indices) & set(
+        slashing.attestation_2.attesting_indices
+    )
+    assert indices
+    yield from _run_op(spec, state, "attester_slashing", slashing)
+    assert all(state.validators[i].slashed for i in indices)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_same_data_rejected(spec, state):
+    from ..testlib.slashings import build_attester_slashing
+
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    slashing = build_attester_slashing(spec, state)
+    slashing.attestation_2 = slashing.attestation_1
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attester_slashing_invalid_sig_2(spec, state):
+    from ..testlib.slashings import build_attester_slashing
+
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    slashing = build_attester_slashing(spec, state, signed=True)
+    slashing.attestation_2.signature = spec.BLSSignature(b"\x11" * 96)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+# --- deposits (test_process_deposit.py)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_new_validator(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    new_index = len(state.validators)
+    deposit = build_deposit_for_index(spec, state, new_index)
+    pre_count = len(state.validators)
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert len(state.validators) == pre_count + 1
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up_existing(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = build_deposit_for_index(spec, state, 0, amount=amount)
+    pre_count = len(state.validators)
+    pre_balance = int(state.balances[0])
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert len(state.validators) == pre_count
+    assert int(state.balances[0]) == pre_balance + int(amount)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_invalid_proof(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    deposit = build_deposit_for_index(spec, state, len(state.validators))
+    proof = list(deposit.proof)
+    proof[3] = spec.Bytes32(b"\xde" * 32)
+    deposit.proof = proof
+    yield from _run_op(spec, state, "deposit", deposit, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_deposit_bad_signature_is_ignored_not_fatal(spec, state):
+    """An invalid proof-of-possession skips validator creation but the
+    deposit itself (and the index bump) still processes. always_bls: the
+    post state is only correct under real signature checks, and the emitted
+    vector must carry bls_setting=1 so clients verify too."""
+    from ..testlib.deposits import build_deposit_for_index
+
+    deposit = build_deposit_for_index(spec, state, len(state.validators), signed=False)
+    pre_count = len(state.validators)
+    pre_index = int(state.eth1_deposit_index)
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert len(state.validators) == pre_count
+    assert int(state.eth1_deposit_index) == pre_index + 1
+
+
+# --- block header (test_process_block_header.py)
+
+
+def _prepare_header_block(spec, state):
+    from ..testlib.block import build_empty_block_for_next_slot
+
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    return block
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_success(spec, state):
+    block = _prepare_header_block(spec, state)
+    yield from _run_op(spec, state, "block_header", block, part_name="block")
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_wrong_slot(spec, state):
+    block = _prepare_header_block(spec, state)
+    block.slot += 1
+    yield from _run_op(spec, state, "block_header", block, valid=False, part_name="block")
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_wrong_proposer(spec, state):
+    block = _prepare_header_block(spec, state)
+    block.proposer_index = (block.proposer_index + 1) % len(state.validators)
+    yield from _run_op(spec, state, "block_header", block, valid=False, part_name="block")
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_slashed_proposer(spec, state):
+    block = _prepare_header_block(spec, state)
+    state.validators[block.proposer_index].slashed = True
+    yield from _run_op(spec, state, "block_header", block, valid=False, part_name="block")
+
+
+# --- sync aggregate (altair+; test/altair/block_processing/test_process_sync_aggregate.py)
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_sync_aggregate_full_participation(spec, state):
+    from ..testlib.sync_committee import build_sync_aggregate
+
+    next_slots(spec, state, 1)
+    aggregate = build_sync_aggregate(spec, state)
+    yield from _run_op(spec, state, "sync_aggregate", aggregate)
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@always_bls
+@spec_state_test
+def test_sync_aggregate_wrong_signature(spec, state):
+    from ..testlib.sync_committee import build_sync_aggregate
+
+    next_slots(spec, state, 1)
+    aggregate = build_sync_aggregate(spec, state)
+    aggregate.sync_committee_signature = spec.BLSSignature(b"\x77" * 96)
+    yield from _run_op(spec, state, "sync_aggregate", aggregate, valid=False)

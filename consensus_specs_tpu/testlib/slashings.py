@@ -1,0 +1,52 @@
+"""Slashing scenario builders.
+
+Reference parity: test/helpers/proposer_slashings.py and
+attester_slashings.py — equivocating header pairs and double-vote indexed
+attestation pairs, signed with the deterministic test keys.
+"""
+from ..crypto import bls
+from .attestations import get_valid_attestation, sign_attestation
+from .keys import privkeys
+
+
+def sign_block_header(spec, state, header, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    return spec.SignedBeaconBlockHeader(message=header, signature=bls.Sign(privkey, signing_root))
+
+
+def build_proposer_slashing(spec, state, proposer_index=None, signed=True):
+    """Two distinct headers for the same (slot, proposer) — equivocation."""
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    header_1 = spec.BeaconBlockHeader(
+        slot=state.slot,
+        proposer_index=proposer_index,
+        parent_root=spec.Root(b"\x33" * 32),
+        state_root=spec.Root(b"\x44" * 32),
+        body_root=spec.Root(b"\x55" * 32),
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = spec.Root(b"\x99" * 32)
+    privkey = privkeys[int(proposer_index)]
+    if signed:
+        signed_1 = sign_block_header(spec, state, header_1, privkey)
+        signed_2 = sign_block_header(spec, state, header_2, privkey)
+    else:
+        signed_1 = spec.SignedBeaconBlockHeader(message=header_1)
+        signed_2 = spec.SignedBeaconBlockHeader(message=header_2)
+    return spec.ProposerSlashing(signed_header_1=signed_1, signed_header_2=signed_2)
+
+
+def build_attester_slashing(spec, state, slot=None, signed=True):
+    """Two attestations by the same committee for the same target epoch with
+    different data — a double vote (is_slashable_attestation_data rule 1)."""
+    att_1 = get_valid_attestation(spec, state, slot=slot, signed=signed)
+    att_2 = att_1.copy()
+    att_2.data.beacon_block_root = spec.Root(b"\x66" * 32)
+    if signed:
+        sign_attestation(spec, state, att_2)
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, att_1),
+        attestation_2=spec.get_indexed_attestation(state, att_2),
+    )

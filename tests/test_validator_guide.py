@@ -1,0 +1,179 @@
+"""Honest-validator duty helpers (unit tests).
+
+Reference parity: test/phase0/unittests/validator/test_validator_unittest.py
+(478 LoC) — committee assignment, proposer detection, aggregation selection,
+subnet computation, eth1 voting, signature constructions; plus the altair
+sync-committee duty helpers (specs/altair/validator.md).
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import next_slots
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture(scope="module")
+def aspec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+@pytest.fixture(scope="module")
+def state(spec):
+    return create_valid_beacon_state(spec, 64)
+
+
+def test_check_if_validator_active(spec, state):
+    assert spec.check_if_validator_active(state, spec.ValidatorIndex(0))
+    # an index beyond the registry is a lookup error, not False
+    with pytest.raises(IndexError):
+        spec.check_if_validator_active(state, spec.ValidatorIndex(10**6))
+
+
+def test_committee_assignment_covers_every_active_validator(spec, state):
+    """Each active validator is assigned to exactly one committee per epoch."""
+    epoch = spec.get_current_epoch(state)
+    seen = {}
+    for vi in range(len(state.validators)):
+        assignment = spec.get_committee_assignment(state, epoch, spec.ValidatorIndex(vi))
+        if spec.is_active_validator(state.validators[vi], epoch):
+            assert assignment is not None
+            committee, index, slot = assignment
+            assert spec.ValidatorIndex(vi) in committee
+            assert spec.compute_epoch_at_slot(slot) == epoch
+            seen[vi] = (int(index), int(slot))
+    assert len(seen) == 64
+    # committees at one (slot, index) agree across members
+    for vi, (index, slot) in seen.items():
+        committee = spec.get_beacon_committee(state, spec.Slot(slot), spec.CommitteeIndex(index))
+        assert spec.ValidatorIndex(vi) in committee
+
+
+def test_committee_assignment_next_epoch_only(spec, state):
+    """Assignments can be looked up at most one epoch ahead."""
+    epoch = spec.get_current_epoch(state)
+    spec.get_committee_assignment(state, epoch + 1, spec.ValidatorIndex(0))
+    with pytest.raises(AssertionError):
+        spec.get_committee_assignment(state, epoch + 2, spec.ValidatorIndex(0))
+
+
+def test_exactly_one_proposer_per_slot(spec, state):
+    st = state.copy()
+    next_slots(spec, st, 1)
+    proposers = [vi for vi in range(len(st.validators)) if spec.is_proposer(st, spec.ValidatorIndex(vi))]
+    assert len(proposers) == 1
+    assert proposers[0] == int(spec.get_beacon_proposer_index(st))
+
+
+def test_compute_subnet_for_attestation_stable_partition(spec):
+    committees_per_slot = spec.uint64(4)
+    subnets = set()
+    for slot in range(int(spec.SLOTS_PER_EPOCH)):
+        for index in range(4):
+            s = spec.compute_subnet_for_attestation(
+                committees_per_slot, spec.Slot(slot), spec.CommitteeIndex(index)
+            )
+            assert 0 <= int(s) < int(spec.ATTESTATION_SUBNET_COUNT)
+            subnets.add(int(s))
+    assert len(subnets) > 1  # spreads over subnets
+
+
+def test_is_aggregator_threshold(spec, state):
+    """Aggregator selection: hash(sig) mod (committee_size // TARGET) == 0 —
+    statistically ~TARGET aggregators per committee; with stub signatures
+    just check determinism + boolean-ness."""
+    sig = b"\x42" * 96
+    got = spec.is_aggregator(state, state.slot, spec.CommitteeIndex(0), sig)
+    assert got == spec.is_aggregator(state, state.slot, spec.CommitteeIndex(0), sig)
+    assert isinstance(bool(got), bool)
+
+
+def test_eth1_vote_majority(spec, state):
+    st = state.copy()
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    # advance into a voting period far enough that candidate windows exist
+    next_slots(spec, st, period - int(st.slot) % period)
+    period_start = spec.voting_period_start_time(st)
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    eth1_chain = [
+        spec.Eth1Block(
+            timestamp=period_start - follow - 1 - i,
+            deposit_root=spec.Root(bytes([i]) * 32),
+            deposit_count=st.eth1_data.deposit_count,
+        )
+        for i in range(4)
+    ]
+    vote = spec.get_eth1_vote(st, eth1_chain)
+    assert vote.deposit_count == st.eth1_data.deposit_count
+    # votes in state bias the outcome toward the majority candidate
+    st2 = st.copy()
+    candidate = spec.get_eth1_data(eth1_chain[2])
+    for _ in range(3):
+        st2.eth1_data_votes.append(candidate)
+    assert spec.get_eth1_vote(st2, eth1_chain) == candidate
+
+
+def test_compute_new_state_root_matches_transition(spec, state):
+    from consensus_specs_tpu.testlib.block import build_empty_block_for_next_slot
+
+    st = state.copy()
+    block = build_empty_block_for_next_slot(spec, st)
+    root = spec.compute_new_state_root(st, block)
+    block.state_root = root
+    # applying with validate_result exercises the same root check
+    signed = spec.SignedBeaconBlock(message=block)
+    spec.state_transition(st, signed, validate_result=False)
+    assert spec.hash_tree_root(st) == root
+
+
+# --- altair sync-committee duties -------------------------------------------
+
+
+def test_sync_committee_period_boundaries(aspec):
+    per = int(aspec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    assert int(aspec.compute_sync_committee_period(aspec.Epoch(0))) == 0
+    assert int(aspec.compute_sync_committee_period(aspec.Epoch(per - 1))) == 0
+    assert int(aspec.compute_sync_committee_period(aspec.Epoch(per))) == 1
+
+
+def test_sync_committee_assignment_consistent(aspec):
+    state = create_valid_beacon_state(aspec, 64)
+    epoch = aspec.get_current_epoch(state)
+    members = {
+        vi
+        for vi in range(len(state.validators))
+        if aspec.is_assigned_to_sync_committee(state, epoch, aspec.ValidatorIndex(vi))
+    }
+    committee_pubkeys = set(bytes(pk) for pk in state.current_sync_committee.pubkeys)
+    for vi in members:
+        assert bytes(state.validators[vi].pubkey) in committee_pubkeys
+    assert members, "someone must be on duty"
+
+
+def test_compute_subnets_for_sync_committee(aspec):
+    state = create_valid_beacon_state(aspec, 64)
+    epoch = aspec.get_current_epoch(state)
+    count = int(aspec.SYNC_COMMITTEE_SUBNET_COUNT)
+    for vi in range(len(state.validators)):
+        if aspec.is_assigned_to_sync_committee(state, epoch, aspec.ValidatorIndex(vi)):
+            subnets = aspec.compute_subnets_for_sync_committee(state, aspec.ValidatorIndex(vi))
+            assert subnets
+            assert all(0 <= int(s) < count for s in subnets)
+
+
+def test_is_sync_committee_aggregator_deterministic(aspec):
+    sig = b"\x07" * 96
+    assert aspec.is_sync_committee_aggregator(sig) == aspec.is_sync_committee_aggregator(sig)

@@ -1,0 +1,75 @@
+"""Weak subjectivity period computation and safe-sync checks.
+
+Reference parity: specs/phase0/weak-subjectivity.md
+(compute_weak_subjectivity_period :87, is_within_weak_subjectivity_period
+:171) and test/phase0/unittests/test_weak_subjectivity.py.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.fork_choice import get_genesis_forkchoice_store_and_block
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("phase0", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+def test_ws_period_lower_bound(spec):
+    """The period never drops below MIN_VALIDATOR_WITHDRAWABILITY_DELAY."""
+    state = create_valid_beacon_state(spec, 64)
+    period = spec.compute_weak_subjectivity_period(state)
+    assert int(period) >= int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+def test_ws_period_grows_with_validator_count(spec):
+    small = create_valid_beacon_state(spec, 64)
+    big = create_valid_beacon_state(spec, 256)
+    assert int(spec.compute_weak_subjectivity_period(big)) >= int(
+        spec.compute_weak_subjectivity_period(small)
+    )
+
+
+def _ws_checkpoint(spec, state):
+    """The spec pins the checkpoint root to the state's own header state-root
+    (is_valid: ws_state.latest_block_header.state_root == ws_checkpoint.root)."""
+    return spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(state.slot),
+        root=state.latest_block_header.state_root,
+    )
+
+
+def test_within_ws_period_fresh_checkpoint(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    assert spec.is_within_weak_subjectivity_period(store, state, _ws_checkpoint(spec, state))
+
+
+def test_outside_ws_period_when_stale(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    ws_checkpoint = _ws_checkpoint(spec, state)
+    period = int(spec.compute_weak_subjectivity_period(state))
+    # age the store far beyond the safe window
+    store.time = int(store.time) + (period + 10) * int(spec.SLOTS_PER_EPOCH) * int(
+        spec.config.SECONDS_PER_SLOT
+    )
+    assert not spec.is_within_weak_subjectivity_period(store, state, ws_checkpoint)
+
+
+def test_ws_checkpoint_must_match_state(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    wrong = spec.Checkpoint(epoch=spec.get_current_epoch(state), root=spec.Root(b"\x13" * 32))
+    with pytest.raises(AssertionError):
+        spec.is_within_weak_subjectivity_period(store, state, wrong)
