@@ -1,0 +1,1 @@
+from .runner import replay_case, replay_tree, CaseResult  # noqa: F401

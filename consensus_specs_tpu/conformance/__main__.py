@@ -1,0 +1,31 @@
+"""CLI: python -m consensus_specs_tpu.conformance <vector-tree-root>
+[--runners a,b] [--presets minimal]
+
+Replays a consensus-spec-tests-layout vector tree against the compiled
+specs and reports pass/fail/skip counts (non-zero exit on failures).
+"""
+import argparse
+import sys
+
+from .runner import replay_tree
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="consensus_specs_tpu.conformance")
+    parser.add_argument("root")
+    parser.add_argument("--runners", default=None, help="comma-separated runner filter")
+    parser.add_argument("--presets", default=None, help="comma-separated preset filter")
+    ns = parser.parse_args(argv)
+    summary = replay_tree(
+        ns.root,
+        runners=set(ns.runners.split(",")) if ns.runners else None,
+        presets=set(ns.presets.split(",")) if ns.presets else None,
+    )
+    for r in summary.failed:
+        print(f"FAIL {r.path}: {r.detail}")
+    print(f"pass={summary.passed} fail={len(summary.failed)} skip={summary.skipped}")
+    return 1 if summary.failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,8 +88,13 @@ def signature_to_G2(signature):
     return _py.signature_to_point(signature)
 
 
-@only_with_bls(alt_return=STUB_PUBKEY)
 def AggregatePKs(pubkeys) -> bytes:
+    """NOT behind the kill-switch: aggregate pubkeys are *state content*
+    (SyncCommittee.aggregate_pubkey via eth_aggregate_pubkeys), not a
+    verification — a stub here would bake fake bytes into states and make
+    vectors generated with BLS on irreproducible by a BLS-off replay
+    (bls_setting 0 means verification is optional, never that state
+    contents change)."""
     return _py.AggregatePKs(pubkeys)
 
 

@@ -51,7 +51,12 @@ def _write_case(case: TestCase, case_dir: Path, log: list[str]) -> bool:
     meta: dict = {}
     for name, kind, value in parts:
         if kind == "meta":
-            meta[name] = value
+            # a dict yielded under the literal name "meta" merges flat —
+            # meta.yaml is a flat mapping in the reference vector format
+            if name == "meta" and isinstance(value, dict):
+                meta.update(value)
+            else:
+                meta[name] = value
         elif kind == "ssz":
             _dump_ssz(case_dir, name, value)
         elif kind == "data":

@@ -182,6 +182,7 @@ def test_slashings_penalty_applied_mid_window(spec, state):
     state.slashings[epoch % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)] = spec.Gwei(total_slashed)
     index = indices[0]
     pre_balance = int(state.balances[index])
+    yield "sub_transition", "meta", "slashings"
     yield "pre", state.copy()
     spec.process_slashings(state)
     yield "post", state.copy()

@@ -56,8 +56,13 @@ def run_epoch_processing_to(spec, state, process_name: str) -> None:
 
 
 def run_epoch_processing_with(spec, state, process_name: str):
-    """Dual-mode runner: yields pre, runs the sub-transition, yields post."""
+    """Dual-mode runner: yields pre, runs the sub-transition, yields post.
+
+    The sub-transition name rides meta.yaml so replay harnesses know which
+    process_* to apply (the reference encodes it in the handler directory;
+    our generator groups by module — meta carries the same information)."""
     run_epoch_processing_to(spec, state, process_name)
+    yield "sub_transition", "meta", process_name.removeprefix("process_")
     yield "pre", state.copy()
     getattr(spec, process_name)(state)
     yield "post", state.copy()

@@ -1,0 +1,99 @@
+"""Round-trip conformance: generate vector trees, replay them, expect clean.
+
+This closes the loop the reference leaves to external clients (SURVEY.md §4
+— vectors as the cross-implementation bus): our generator output must be
+replayable bit-for-bit by our own conformance harness. Runs with BLS stubbed
+(bls_setting 0) for speed; signature-critical vectors carry bls_setting=1
+and are exercised by the real-BLS generator runs instead.
+"""
+from pathlib import Path
+
+import pytest
+
+from consensus_specs_tpu.conformance import replay_tree
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.gen.gen_from_tests import generate_from_tests
+from consensus_specs_tpu.gen.gen_runner import _write_case
+from consensus_specs_tpu.spec_tests import (
+    epoch_processing,
+    fork_choice,
+    forks,
+    genesis,
+    operations,
+    sanity_blocks,
+)
+
+
+def _generate(tmp_path, runner, handler, module, fork="phase0", prefix=""):
+    log = []
+    written = 0
+    for case in generate_from_tests(
+        runner, handler, module, fork, "minimal", bls_active=False, name_prefix=prefix
+    ):
+        case_dir = Path(tmp_path) / case.path
+        if _write_case(case, case_dir, log):
+            written += 1
+    assert not log, log
+    return written
+
+
+@pytest.fixture(autouse=True)
+def _stub_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+def _assert_clean(summary, minimum):
+    assert not summary.failed, [f"{r.path}: {r.detail}" for r in summary.failed][:5]
+    assert summary.passed >= minimum
+    assert summary.skipped == 0
+
+
+def test_roundtrip_operations(tmp_path):
+    n = _generate(tmp_path, "operations", "operations", operations)
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_roundtrip_epoch_processing(tmp_path):
+    n = _generate(tmp_path, "epoch_processing", "epoch_processing", epoch_processing)
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_roundtrip_sanity_blocks(tmp_path):
+    n = _generate(tmp_path, "sanity", "blocks", sanity_blocks)
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_roundtrip_forks(tmp_path):
+    n = _generate(tmp_path, "forks", "fork", forks)
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_roundtrip_genesis(tmp_path):
+    n = _generate(tmp_path, "genesis", "initialization", genesis, prefix="initialize_")
+    n += _generate(tmp_path, "genesis", "validity", genesis, prefix="validity_")
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_roundtrip_fork_choice(tmp_path):
+    n = _generate(tmp_path, "fork_choice", "core", fork_choice)
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
+
+
+def test_replay_detects_corruption(tmp_path):
+    """A tampered post state must surface as a failure, not a pass."""
+    _generate(tmp_path, "sanity", "blocks", sanity_blocks)
+    # corrupt one post.ssz_snappy by swapping in the pre state
+    posts = sorted(Path(tmp_path).glob("*/*/*/*/*/*/post.ssz_snappy"))
+    pres = posts[0].parent / "pre.ssz_snappy"
+    posts[0].write_bytes(pres.read_bytes())
+    summary = replay_tree(tmp_path)
+    assert summary.failed, "corrupted vector not detected"
