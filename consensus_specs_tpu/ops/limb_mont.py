@@ -11,6 +11,19 @@ a carry-scheme or bound fix lands in exactly one place.
 Magnitude analysis (worst case, nlimbs = 24): schoolbook columns accumulate
 ≤ 24·(2^16-1)^2 ≈ 2^36.6; each Montgomery round adds m·p (≤ 2^32 per
 column) plus a folded carry (≤ 2^21) — far below the uint64 ceiling.
+
+Perf notes (measured, TPU v5e, pairing_check_batch):
+- this fori/dynamic-slice form: ~27ms/verify, compile ~750s (batch 64);
+  throughput flat in batch size (59/s at 2048) => VPU-compute-bound.
+- a fully parallel rewrite (broadcast poly-mul + pad-stack-sum columns,
+  full-word Montgomery reduction, bounded magnitude passes +
+  associative-scan carry-lookahead) was built and differentially validated:
+  TPU runtime equivalent (32/s), compile ~20%% faster, but CPU (test-suite)
+  10x SLOWER — XLA/CPU lowers the fori form to tight loops. Reverted.
+- the real path to the 100k/s target is a representation change that puts
+  limb products on the MXU (int8 limbs with int32 matmul accumulation, or
+  RNS), likely as a Pallas kernel with explicit VMEM tiling — tracked for
+  the next round.
 """
 from __future__ import annotations
 
