@@ -55,6 +55,11 @@ clean_vectors:
 detect_generator_incomplete:
 	@find $(TEST_VECTOR_DIR) -name INCOMPLETE 2>/dev/null || true
 
+# Replay a vector tree (ours or an external consensus-spec-tests corpus)
+# against the compiled specs; non-zero exit on any mismatch.
+replay:
+	$(PYTHON) -m consensus_specs_tpu.conformance $(TEST_VECTOR_DIR)
+
 # Native components (ctypes-loaded C++).
 native:
 	$(MAKE) -C consensus_specs_tpu/native
