@@ -11,7 +11,8 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              merkle random
 
 .PHONY: test citest testfast lint pyspec generate_tests clean_vectors \
-        detect_generator_incomplete bench graft_check native
+        detect_generator_incomplete bench graft_check native replay \
+        random_codegen
 
 # Default developer loop: full suite (minimal preset, BLS stubbed where the
 # suite chooses; JAX pinned to the virtual 8-device CPU mesh by tests/conftest.py).

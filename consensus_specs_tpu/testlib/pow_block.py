@@ -1,0 +1,35 @@
+"""Synthetic PoW-chain mocking for merge-transition tests.
+
+Reference parity: test/helpers/pow_block.py + the get_pow_block stub the
+reference injects at build time (setup.py:513-514) — tests patch the spec
+module's `get_pow_block` to serve from an in-memory chain dict.
+"""
+from contextlib import contextmanager
+
+
+def prepare_terminal_pow_chain(spec):
+    """(parent, terminal) PoW pair straddling TERMINAL_TOTAL_DIFFICULTY."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    parent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x01" * 32),
+        parent_hash=spec.Hash32(b"\x00" * 32),
+        total_difficulty=spec.uint256(ttd - 1),
+    )
+    terminal = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x02" * 32),
+        parent_hash=parent.block_hash,
+        total_difficulty=spec.uint256(ttd),
+    )
+    return parent, terminal
+
+
+@contextmanager
+def pow_chain(spec, blocks):
+    """Patch spec.get_pow_block to serve from `blocks` for the duration."""
+    table = {bytes(b.block_hash): b for b in blocks}
+    prev = spec.get_pow_block
+    spec.get_pow_block = lambda block_hash: table.get(bytes(block_hash))
+    try:
+        yield table
+    finally:
+        spec.get_pow_block = prev

@@ -105,11 +105,9 @@ def test_upgrade_to_bellatrix(spec):
 
 
 def test_terminal_pow_validation(spec):
-    ttd = spec.config.TERMINAL_TOTAL_DIFFICULTY
-    genesis_pow = spec.PowBlock(block_hash=b"\x01" * 32, parent_hash=b"\x00" * 32,
-                                total_difficulty=ttd - 1)
-    terminal = spec.PowBlock(block_hash=b"\x02" * 32, parent_hash=b"\x01" * 32,
-                             total_difficulty=ttd)
+    from consensus_specs_tpu.testlib.pow_block import prepare_terminal_pow_chain
+
+    genesis_pow, terminal = prepare_terminal_pow_chain(spec)
     assert spec.is_valid_terminal_pow_block(terminal, genesis_pow)
     assert not spec.is_valid_terminal_pow_block(genesis_pow, genesis_pow)
     pow_chain = {bytes(b.block_hash): b for b in (genesis_pow, terminal)}

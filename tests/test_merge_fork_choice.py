@@ -1,0 +1,111 @@
+"""Bellatrix fork choice: merge-transition block validation in on_block.
+
+Reference parity: test/bellatrix/fork_choice/test_on_merge_block.py and
+specs/bellatrix/fork-choice.md (validate_merge_block, terminal-PoW checks,
+TERMINAL_BLOCK_HASH override) — exercised through a mocked PoW chain
+(testlib/pow_block.py).
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import build_spec, get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.fork_choice import get_genesis_forkchoice_store_and_block
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.pow_block import pow_chain, prepare_terminal_pow_chain
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("bellatrix", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+def _merge_block_through_store(spec, terminal_hash):
+    """Genesis (pre-merge) store + a signed transition block whose payload
+    builds on `terminal_hash`."""
+    state = create_valid_beacon_state(spec, 64)
+    # rewind the state to a pre-merge execution header
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+    store, _ = get_genesis_forkchoice_store_and_block(spec, state)
+    spec.on_tick(store, int(store.time) + int(spec.config.SECONDS_PER_SLOT))
+
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = spec.ExecutionPayload()
+    payload.parent_hash = spec.Hash32(terminal_hash)
+    payload.random = spec.get_randao_mix(state, spec.get_current_epoch(state))
+    payload.timestamp = spec.compute_timestamp_at_slot(state, block.slot)
+    payload.block_hash = spec.Hash32(b"\xcc" * 32)
+    payload.block_number = 1
+    block.body.execution_payload = payload
+    assert spec.is_merge_transition_block(state, block.body)
+    # transition a scratch copy to fill state_root + sign (the store's
+    # on_block will redo the real transition itself)
+    signed = state_transition_and_sign_block(spec, state.copy(), block)
+    return store, signed
+
+
+def test_on_merge_block_valid_terminal_ancestry(spec):
+    parent, terminal = prepare_terminal_pow_chain(spec)
+    store, signed = _merge_block_through_store(spec, terminal.block_hash)
+    with pow_chain(spec, [parent, terminal]):
+        spec.on_block(store, signed)
+    assert spec.hash_tree_root(signed.message) in store.blocks
+
+
+def test_on_merge_block_unknown_pow_parent_rejected(spec):
+    _, terminal = prepare_terminal_pow_chain(spec)
+    store, signed = _merge_block_through_store(spec, terminal.block_hash)
+    # terminal's own parent missing from the PoW chain view
+    with pow_chain(spec, [terminal]):
+        with pytest.raises(AssertionError):
+            spec.on_block(store, signed)
+
+
+def test_on_merge_block_pre_ttd_parent_rejected(spec):
+    parent, terminal = prepare_terminal_pow_chain(spec)
+    store, signed = _merge_block_through_store(spec, parent.block_hash)
+    # payload builds on a PoW block that has NOT reached terminal difficulty
+    grandparent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x03" * 32),
+        parent_hash=spec.Hash32(b"\x04" * 32),
+        total_difficulty=spec.uint256(0),
+    )
+    parent = parent.copy()
+    parent.parent_hash = grandparent.block_hash
+    with pow_chain(spec, [grandparent, parent, terminal]):
+        with pytest.raises(AssertionError):
+            spec.on_block(store, signed)
+
+
+def test_terminal_block_hash_override(spec):
+    """With TERMINAL_BLOCK_HASH set, ancestry checks are replaced by an
+    exact parent-hash + activation-epoch gate."""
+    override = b"\x77" * 32
+    ospec = build_spec(
+        "bellatrix",
+        "minimal",
+        config_overrides={
+            "TERMINAL_BLOCK_HASH": "0x" + override.hex(),
+            "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+        },
+    )
+    store, signed = _merge_block_through_store(ospec, override)
+    # no PoW chain mock needed: the override path never calls get_pow_block
+    ospec.on_block(store, signed)
+    assert ospec.hash_tree_root(signed.message) in store.blocks
+    # wrong parent hash must be rejected
+    store2, signed2 = _merge_block_through_store(ospec, b"\x78" * 32)
+    with pytest.raises(AssertionError):
+        ospec.on_block(store2, signed2)
