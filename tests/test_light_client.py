@@ -1,0 +1,156 @@
+"""Light-client sync protocol: store updates, timeouts, safety thresholds.
+
+Reference parity: specs/altair/sync-protocol.md (validate :92, apply :143,
+process_slot_for_light_client_store :80, process_light_client_update :152)
+and test/altair/unittests/test_sync_protocol.py. Complements the real-proof
+test in test_altair.py with the store state-machine behaviors.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def disable_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+def _store_from_state(spec, state):
+    header = spec.BeaconBlockHeader(state_root=spec.hash_tree_root(state))
+    return spec.LightClientStore(
+        finalized_header=header,
+        current_sync_committee=state.current_sync_committee,
+        next_sync_committee=state.next_sync_committee,
+    )
+
+
+def _same_period_update(spec, state, store, participants=None):
+    """Minimal valid same-period update: empty finalized header + zeroed
+    branches (the spec's explicit empty-proof shape)."""
+    n = participants if participants is not None else int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [i < n for i in range(int(spec.SYNC_COMMITTEE_SIZE))]
+    attested = spec.BeaconBlockHeader(
+        slot=store.finalized_header.slot + 1, state_root=spec.Root(b"\x01" * 32)
+    )
+    return spec.LightClientUpdate(
+        attested_header=attested,
+        next_sync_committee=spec.SyncCommittee(),
+        next_sync_committee_branch=[
+            spec.Bytes32() for _ in range(spec.floorlog2(spec.NEXT_SYNC_COMMITTEE_INDEX))
+        ],
+        finalized_header=spec.BeaconBlockHeader(),
+        finality_branch=[
+            spec.Bytes32() for _ in range(spec.floorlog2(spec.FINALIZED_ROOT_INDEX))
+        ],
+        sync_committee_aggregate=spec.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=spec.BLSSignature(b"\x11" * 96),
+        ),
+        fork_version=state.fork.current_version,
+    )
+
+
+def test_get_safety_threshold(spec):
+    store = spec.LightClientStore(
+        finalized_header=spec.BeaconBlockHeader(),
+        current_sync_committee=spec.SyncCommittee(),
+        next_sync_committee=spec.SyncCommittee(),
+        previous_max_active_participants=spec.uint64(10),
+        current_max_active_participants=spec.uint64(30),
+    )
+    assert int(spec.get_safety_threshold(store)) == 15
+
+
+def test_process_update_tracks_best_and_participants(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store = _store_from_state(spec, state)
+    current_slot = spec.Slot(int(store.finalized_header.slot) + 2)
+
+    weak = _same_period_update(spec, state, store, participants=3)
+    spec.process_light_client_update(
+        store, weak, current_slot, state.genesis_validators_root
+    )
+    assert store.best_valid_update == weak
+    assert int(store.current_max_active_participants) == 3
+
+    strong = _same_period_update(spec, state, store, participants=20)
+    spec.process_light_client_update(
+        store, strong, current_slot, state.genesis_validators_root
+    )
+    assert store.best_valid_update == strong
+    assert int(store.current_max_active_participants) == 20
+
+
+def test_validate_rejects_stale_and_future(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store = _store_from_state(spec, state)
+    store.finalized_header.slot = spec.Slot(10)
+    update = _same_period_update(spec, state, store)
+
+    # not newer than the finalized header
+    update.attested_header.slot = spec.Slot(10)
+    with pytest.raises(AssertionError):
+        spec.validate_light_client_update(
+            store, update, spec.Slot(20), state.genesis_validators_root
+        )
+    # from the future relative to current slot
+    update.attested_header.slot = spec.Slot(30)
+    with pytest.raises(AssertionError):
+        spec.validate_light_client_update(
+            store, update, spec.Slot(20), state.genesis_validators_root
+        )
+
+
+def test_validate_rejects_insufficient_participants(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store = _store_from_state(spec, state)
+    update = _same_period_update(spec, state, store, participants=0)
+    with pytest.raises(AssertionError):
+        spec.validate_light_client_update(
+            store,
+            update,
+            spec.Slot(int(update.attested_header.slot) + 1),
+            state.genesis_validators_root,
+        )
+
+
+def test_forced_update_after_timeout(spec):
+    state = create_valid_beacon_state(spec, 64)
+    store = _store_from_state(spec, state)
+    update = _same_period_update(spec, state, store, participants=8)
+    current_slot = spec.Slot(int(store.finalized_header.slot) + 2)
+    spec.process_light_client_update(
+        store, update, current_slot, state.genesis_validators_root
+    )
+    assert store.best_valid_update is not None
+    pre_finalized_slot = int(store.finalized_header.slot)
+
+    # time out: the store force-applies its best pending update
+    timeout_slot = spec.Slot(pre_finalized_slot + int(spec.UPDATE_TIMEOUT) + 1)
+    spec.process_slot_for_light_client_store(store, timeout_slot)
+    assert store.best_valid_update is None
+    assert int(store.finalized_header.slot) > pre_finalized_slot
+
+
+def test_participant_window_rotation(spec):
+    store = spec.LightClientStore(
+        finalized_header=spec.BeaconBlockHeader(),
+        current_sync_committee=spec.SyncCommittee(),
+        next_sync_committee=spec.SyncCommittee(),
+        previous_max_active_participants=spec.uint64(5),
+        current_max_active_participants=spec.uint64(12),
+    )
+    boundary = spec.Slot(int(spec.UPDATE_TIMEOUT) * 4)
+    spec.process_slot_for_light_client_store(store, boundary)
+    assert int(store.previous_max_active_participants) == 12
+    assert int(store.current_max_active_participants) == 0
