@@ -1,0 +1,79 @@
+"""KZG shim for the executable sharding spec.
+
+The role `utils/bls.py` plays for signatures (reference utils/bls.py:6,33-44:
+a single boundary with a `bls_active` kill-switch so the fast test matrix can
+skip the expensive crypto), this module plays for the sharding spec's
+polynomial-commitment checks (`process_shard_header`'s degree-bound pairing,
+reference specs/sharding/beacon-chain.md:716-719). The compiled spec modules
+see this module as `kzg` (compiler namespace), the same way they see the BLS
+shim as `bls`.
+
+The trusted setup (`G1_SETUP`/`G2_SETUP`, reference :172-173) is
+externally-supplied ceremony data the spec treats as constants; here it is
+process-global installable state (`use_setup`), with
+`crypto/kzg.insecure_test_setup` as the test-time source. When `bls.bls_active`
+is off (stub-crypto test mode) every check passes, mirroring the BLS
+kill-switch contract.
+"""
+from __future__ import annotations
+
+from . import bls, kzg
+from .bls12_381 import g1_from_bytes, g1_to_bytes, pt_to_affine
+from .kzg import FP_FIELD, KZGSetup
+
+_setup: KZGSetup | None = None
+
+
+def use_setup(setup: KZGSetup | None) -> None:
+    """Install (or with None, clear) the process-global trusted setup."""
+    global _setup
+    _setup = setup
+
+
+def get_setup() -> KZGSetup:
+    assert _setup is not None, "no KZG setup installed (kzg_shim.use_setup)"
+    return _setup
+
+
+def identity_commitment() -> bytes:
+    """Compressed `G1_SETUP[0]` — the required degree proof for zero-length
+    blobs (reference :713-714)."""
+    return g1_to_bytes(pt_to_affine(FP_FIELD, get_setup().g1[0]))
+
+
+def is_identity_commitment(proof: bytes) -> bool:
+    if not bls.bls_active:
+        return True
+    return bytes(proof) == identity_commitment()
+
+
+def verify_degree_bound(commitment: bytes, degree_proof: bytes, points_count: int) -> bool:
+    """e(degree_proof, G2_SETUP[0]) == e(commitment, G2_SETUP[-points_count])
+    (reference :716-719) over compressed inputs; decompression failures are
+    rejections (both fields arrive from the network inside a block body)."""
+    if not bls.bls_active:
+        return True
+    try:
+        c = g1_from_bytes(bytes(commitment))
+        p = g1_from_bytes(bytes(degree_proof))
+    except ValueError:
+        return False
+    return kzg.verify_degree_proof(get_setup(), c, p, int(points_count))
+
+
+def commit_to_data(points: list[int]) -> bytes:
+    """Builder-side helper: commitment for a blob's scalar points (the data
+    IS the evaluation form at the setup's domain in the real protocol; the
+    test harness commits to the coefficient form directly)."""
+    if not bls.bls_active:
+        return b"\xc0" + b"\x00" * 47
+    return kzg.commit_bytes(get_setup(), [p % kzg.MODULUS for p in points])
+
+
+def prove_degree_bound_bytes(points: list[int], points_count: int) -> bytes:
+    if not bls.bls_active:
+        return b"\xc0" + b"\x00" * 47
+    if points_count == 0:
+        return identity_commitment()
+    proof = kzg.prove_degree_bound(get_setup(), [p % kzg.MODULUS for p in points], points_count)
+    return g1_to_bytes(pt_to_affine(FP_FIELD, proof))

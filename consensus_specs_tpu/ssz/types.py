@@ -1004,6 +1004,13 @@ class Union(SSZType, metaclass=_ParamMeta):
         root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
         return mix_in_selector(root, self.selector)
 
+    def change(self, selector: int, value=None):
+        """In-place re-tag (the sharding spec's `status.change(...)` idiom on
+        `ShardWork` cells, reference specs/sharding/beacon-chain.md:660-668)."""
+        replacement = type(self)(selector, value)
+        self.selector = replacement.selector
+        self.value = replacement.value
+
     def copy(self):
         v = self.value
         return type(self)(self.selector, v.copy() if hasattr(v, "copy") else v)

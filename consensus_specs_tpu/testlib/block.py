@@ -41,7 +41,7 @@ def build_empty_block(spec, state, slot=None):
     if spec.fork != "phase0":
         # Empty-participation sync aggregate: valid with the infinity signature
         block.body.sync_aggregate.sync_committee_signature = spec.G2_POINT_AT_INFINITY
-    if spec.fork == "bellatrix":
+    if spec.fork in ("bellatrix", "sharding", "custody_game"):
         if spec.is_merge_transition_complete(state):
             block.body.execution_payload = build_empty_execution_payload(spec, state)
         else:

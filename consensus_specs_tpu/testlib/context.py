@@ -42,6 +42,11 @@ from .genesis import create_genesis_state
 PHASE0 = "phase0"
 ALTAIR = "altair"
 BELLATRIX = "bellatrix"
+SHARDING = "sharding"
+CUSTODY_GAME = "custody_game"
+# ALL_PHASES stays the stable fork set (the reference's with_all_phases
+# universe); sharding-era forks compile here (unlike the reference) but opt
+# in per-test via with_phases([SHARDING]) etc.
 ALL_PHASES = (PHASE0, ALTAIR, BELLATRIX)
 MINIMAL = "minimal"
 MAINNET = "mainnet"

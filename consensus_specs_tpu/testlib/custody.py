@@ -1,0 +1,147 @@
+"""Custody-game scenario builders.
+
+Reference parity: the role test/helpers/custody.py plays for the reference's
+custody_game suite (key reveals, early derived secret reveals, chunk
+challenge/response payloads, custody slashings), rebuilt against this
+framework's executable custody overlay (specs/custody_game/beacon-chain.md),
+whose challenges link to `ShardBlobHeader`s instead of the reference's
+retired `ShardTransition`.
+"""
+from __future__ import annotations
+
+from ..crypto import bls
+from ..ssz import hash_tree_root
+from ..ssz.merkle import merkleize_chunks, mix_in_length
+from .keys import pubkey_to_privkey
+
+
+def custody_reveal_signature(spec, state, revealer_index, period=None):
+    """A validator's key reveal for `period` (default: the one currently owed)."""
+    revealer = state.validators[revealer_index]
+    if period is None:
+        period = revealer.next_custody_secret_to_reveal
+    epoch_to_sign = spec.get_randao_epoch_for_custody_period(period, revealer_index)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch_to_sign)
+    signing_root = spec.compute_signing_root(spec.Epoch(epoch_to_sign), domain)
+    return bls.Sign(pubkey_to_privkey(bytes(revealer.pubkey)), signing_root)
+
+
+def get_valid_custody_key_reveal(spec, state, revealer_index=0, period=None):
+    return spec.CustodyKeyReveal(
+        revealer_index=revealer_index,
+        reveal=custody_reveal_signature(spec, state, revealer_index, period),
+    )
+
+
+def get_valid_early_derived_secret_reveal(spec, state, revealed_index=0,
+                                          masker_index=None, epoch=None):
+    """Masked early reveal: aggregate of (revealed validator's signature over
+    the epoch, masker's signature over the mask)."""
+    if masker_index is None:
+        masker_index = (revealed_index + 1) % len(state.validators)
+    current_epoch = spec.get_current_epoch(state)
+    if epoch is None:
+        epoch = spec.Epoch(current_epoch + spec.CUSTODY_PERIOD_TO_RANDAO_PADDING)
+    mask = spec.hash(spec.uint_to_bytes(spec.Epoch(epoch)))
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    reveal_root = spec.compute_signing_root(spec.Epoch(epoch), domain)
+    mask_root = spec.compute_signing_root(spec.Bytes32(mask), domain)
+    if bls.bls_active:
+        signature = bls.Aggregate([
+            bls.Sign(pubkey_to_privkey(bytes(state.validators[revealed_index].pubkey)), reveal_root),
+            bls.Sign(pubkey_to_privkey(bytes(state.validators[masker_index].pubkey)), mask_root),
+        ])
+    else:
+        signature = bls.STUB_SIGNATURE
+    return spec.EarlyDerivedSecretReveal(
+        revealed_index=revealed_index,
+        epoch=epoch,
+        reveal=signature,
+        masker_index=masker_index,
+        mask=mask,
+    )
+
+
+def data_chunk_bytes(spec, points, chunk_index):
+    """The `chunk_index`-th BYTES_PER_CUSTODY_CHUNK window of the blob's
+    serialized points (zero-padded past the data end)."""
+    raw = b"".join(int(p).to_bytes(32, "little") for p in points)
+    start = chunk_index * spec.BYTES_PER_CUSTODY_CHUNK
+    window = raw[start:start + spec.BYTES_PER_CUSTODY_CHUNK]
+    return window + b"\x00" * (spec.BYTES_PER_CUSTODY_CHUNK - len(window))
+
+
+def build_chunk_branch(spec, points, chunk_index):
+    """Merkle branch from the chunk's subtree root to the data list's root
+    (CUSTODY_RESPONSE_DEPTH siblings + the length mix-in chunk)."""
+    limit_points = spec.POINTS_PER_SAMPLE * spec.MAX_SAMPLES_PER_BLOB
+    per_chunk = spec.POINTS_PER_CUSTODY_CHUNK
+    n_chunk_slots = limit_points // per_chunk
+    # subtree root per custody chunk across the whole (padded) limit
+    chunk_roots = []
+    for j in range(n_chunk_slots):
+        window = points[j * per_chunk:(j + 1) * per_chunk]
+        leaves = [int(p).to_bytes(32, "little") for p in window]
+        leaves += [b"\x00" * 32] * (per_chunk - len(leaves))
+        chunk_roots.append(merkleize_chunks(leaves))
+    # branch within the chunk-root tree
+    branch = []
+    nodes = chunk_roots
+    idx = chunk_index
+    for _ in range(spec.CUSTODY_RESPONSE_DEPTH):
+        branch.append(nodes[idx ^ 1])
+        nodes = [spec.hash(nodes[i] + nodes[i + 1]) for i in range(0, len(nodes), 2)]
+        idx //= 2
+    # length mix-in sibling
+    branch.append(len(points).to_bytes(32, "little"))
+    root = mix_in_length(nodes[0], len(points))
+    assert root == hash_tree_root(
+        spec.List[spec.BLSPoint, limit_points](points)), "branch construction out of sync"
+    return branch
+
+
+def get_valid_chunk_challenge(spec, state, attestation, header, responder_index=None,
+                              chunk_index=0):
+    if responder_index is None:
+        attesters = spec.get_attesting_indices(
+            state, attestation.data, attestation.aggregation_bits)
+        responder_index = min(attesters)
+    return spec.CustodyChunkChallenge(
+        responder_index=responder_index,
+        attestation=attestation,
+        header=header,
+        chunk_index=chunk_index,
+    )
+
+
+def get_valid_chunk_response(spec, state, challenge_record, points, chunk_index=None):
+    if chunk_index is None:
+        chunk_index = int(challenge_record.chunk_index)
+    return spec.CustodyChunkResponse(
+        challenge_index=challenge_record.challenge_index,
+        chunk_index=chunk_index,
+        chunk=data_chunk_bytes(spec, points, chunk_index),
+        branch=build_chunk_branch(spec, points, chunk_index),
+    )
+
+
+def get_custody_slashing(spec, state, attestation, header, points, malefactor_index,
+                         whistleblower_index, malefactor_secret=None):
+    if malefactor_secret is None:
+        # the malefactor's custody key for the attestation's period
+        period = spec.get_custody_period_for_validator(
+            malefactor_index, attestation.data.target.epoch)
+        malefactor_secret = custody_reveal_signature(spec, state, malefactor_index, period)
+    slashing = spec.CustodySlashing(
+        malefactor_index=malefactor_index,
+        malefactor_secret=malefactor_secret,
+        whistleblower_index=whistleblower_index,
+        attestation=attestation,
+        header=header,
+        data=points,
+    )
+    domain = spec.get_domain(state, spec.DOMAIN_CUSTODY_BIT_SLASHING, spec.get_current_epoch(state))
+    signing_root = spec.compute_signing_root(slashing, domain)
+    signature = bls.Sign(
+        pubkey_to_privkey(bytes(state.validators[whistleblower_index].pubkey)), signing_root)
+    return spec.SignedCustodySlashing(message=slashing, signature=signature)

@@ -26,6 +26,12 @@ def build_mock_validator(spec, i: int, balance: int):
             spec.MAX_EFFECTIVE_BALANCE,
         ),
     )
+    if hasattr(spec, "get_custody_period_for_validator"):
+        # custody_game fork: genesis validators owe from period 0 and have
+        # revealed nothing (custody_game/beacon-chain.md deposit init).
+        validator.next_custody_secret_to_reveal = spec.get_custody_period_for_validator(
+            spec.ValidatorIndex(i), spec.Epoch(0))
+        validator.all_custody_secrets_revealed_epoch = spec.FAR_FUTURE_EPOCH
     return validator
 
 
@@ -70,8 +76,12 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
         state.current_sync_committee = spec.get_next_sync_committee(state)
         state.next_sync_committee = spec.get_next_sync_committee(state)
 
-    if spec.fork == "bellatrix":
+    if spec.fork in ("bellatrix", "sharding", "custody_game"):
         state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+
+    if hasattr(spec, "MIN_SAMPLE_PRICE"):
+        # Sharding-era: the fee controller floors at MIN_SAMPLE_PRICE.
+        state.shard_sample_price = spec.MIN_SAMPLE_PRICE
 
     return state
 

@@ -1,0 +1,138 @@
+"""Shard-blob scenario builders for the sharding fork overlay.
+
+Reference parity: the role test/helpers/shard_block.py plays for the
+reference's sharding tests — builder registration, signed blob headers, and
+ring-buffer arming — rebuilt against this framework's executable sharding
+spec (specs/sharding/beacon-chain.md).
+"""
+from __future__ import annotations
+
+from ..crypto import bls, kzg_shim
+from ..ssz import hash_tree_root
+from .keys import NUM_KEYS, get_pubkeys, privkeys, pubkey_to_privkey
+
+
+def builder_privkey(builder_slot: int) -> int:
+    """Builders take keys from the top of the fixture range, clear of the
+    validator registry (minimal worlds use 64..256 validators)."""
+    return privkeys[NUM_KEYS - 1 - builder_slot]
+
+
+def register_builder(spec, state, balance=None, key_slot=None):
+    """Append a blob builder (+balance) to the registry; returns its index."""
+    index = len(state.blob_builders)
+    pubkey = get_pubkeys()[NUM_KEYS - 1 - (key_slot if key_slot is not None else index)]
+    state.blob_builders.append(spec.Builder(pubkey=pubkey))
+    state.blob_builder_balances.append(
+        spec.Gwei(balance if balance is not None else spec.MAX_EFFECTIVE_BALANCE))
+    return spec.BuilderIndex(index)
+
+
+def make_blob_points(spec, samples_count: int, seed: int = 1):
+    """Deterministic in-field scalar points for a blob of `samples_count`."""
+    n = samples_count * spec.POINTS_PER_SAMPLE
+    return [(seed * 0x9E3779B97F4A7C15 + i) % spec.MODULUS for i in range(n)]
+
+
+def build_blob_body(spec, points, max_priority_fee_per_sample=0, max_fee_per_sample=None):
+    """ShardBlobBody with a real (or stub-mode) commitment + degree proof."""
+    samples_count = len(points) // spec.POINTS_PER_SAMPLE
+    if max_fee_per_sample is None:
+        max_fee_per_sample = spec.MIN_SAMPLE_PRICE
+    commitment_point = kzg_shim.commit_to_data(points)
+    degree_proof = kzg_shim.prove_degree_bound_bytes(points, len(points))
+    return spec.ShardBlobBody(
+        commitment=spec.DataCommitment(point=commitment_point, samples_count=samples_count),
+        degree_proof=degree_proof,
+        data=points,
+        max_priority_fee_per_sample=max_priority_fee_per_sample,
+        max_fee_per_sample=max_fee_per_sample,
+    )
+
+
+def body_to_summary(spec, body):
+    return spec.ShardBlobBodySummary(
+        commitment=body.commitment,
+        degree_proof=body.degree_proof,
+        data_root=hash_tree_root(body.data),
+        max_priority_fee_per_sample=body.max_priority_fee_per_sample,
+        max_fee_per_sample=body.max_fee_per_sample,
+    )
+
+
+def sign_shard_blob_header(spec, state, header, builder_index=None):
+    """Joint builder+proposer signature (one FastAggregateVerify target)."""
+    if not bls.bls_active:
+        return bls.STUB_SIGNATURE
+    signing_root = spec.compute_signing_root(
+        header, spec.get_domain(state, spec.DOMAIN_SHARD_BLOB))
+    builder_pk = state.blob_builders[header.builder_index].pubkey
+    proposer_pk = state.validators[header.proposer_index].pubkey
+    sigs = [
+        bls.Sign(pubkey_to_privkey(bytes(builder_pk)), signing_root),
+        bls.Sign(pubkey_to_privkey(bytes(proposer_pk)), signing_root),
+    ]
+    return bls.Aggregate(sigs)
+
+
+def build_signed_shard_blob_header(spec, state, slot=None, shard=0, builder_index=0,
+                                   samples_count=1, points=None,
+                                   max_priority_fee_per_sample=0, max_fee_per_sample=None,
+                                   valid_signature=True):
+    """A SignedShardBlobHeader ready for process_shard_header at `state.slot`."""
+    if slot is None:
+        slot = state.slot
+    if points is None:
+        points = make_blob_points(spec, samples_count)
+    body = build_blob_body(spec, points,
+                           max_priority_fee_per_sample=max_priority_fee_per_sample,
+                           max_fee_per_sample=max_fee_per_sample)
+    header = spec.ShardBlobHeader(
+        slot=slot,
+        shard=shard,
+        builder_index=builder_index,
+        proposer_index=spec.get_shard_proposer_index(state, slot, shard),
+        body_summary=body_to_summary(spec, body),
+    )
+    signature = sign_shard_blob_header(spec, state, header) if valid_signature \
+        else spec.BLSSignature(b"\x42" * 96)
+    return spec.SignedShardBlobHeader(message=header, signature=signature), body
+
+
+def arm_shard_cells(spec, state, epoch=None):
+    """Arm the ring-buffer cells for `epoch` (default: current) the way
+    reset_pending_shard_work arms the next epoch — needed at genesis, where
+    no epoch transition has run yet."""
+    if epoch is None:
+        epoch = spec.get_current_epoch(state)
+    start_slot = spec.compute_start_slot_at_epoch(epoch)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    active_shards = spec.get_active_shard_count(state, epoch)
+    for slot in range(start_slot, start_slot + spec.SLOTS_PER_EPOCH):
+        buffer_index = slot % spec.SHARD_STATE_MEMORY_SLOTS
+        state.shard_buffer[buffer_index] = [spec.ShardWork() for _ in range(active_shards)]
+        start_shard = spec.get_start_shard(state, slot)
+        for committee_index in range(committees_per_slot):
+            shard = (int(start_shard) + committee_index) % int(active_shards)
+            committee_length = len(spec.get_beacon_committee(
+                state, slot, spec.CommitteeIndex(committee_index)))
+            pending_type = spec.List[spec.PendingShardHeader, spec.MAX_SHARD_HEADERS_PER_SHARD]
+            state.shard_buffer[buffer_index][shard].status.change(
+                selector=spec.SHARD_WORK_PENDING,
+                value=pending_type(
+                    spec.PendingShardHeader(
+                        attested=spec.AttestedDataCommitment(),
+                        votes=spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]([0] * committee_length),
+                        weight=0,
+                        update_slot=slot,
+                    )
+                ),
+            )
+
+
+def committee_index_for_shard(spec, state, slot, shard):
+    return spec.compute_committee_index_from_shard(state, slot, spec.Shard(shard))
+
+
+def shard_for_committee_index(spec, state, slot, index=0):
+    return spec.compute_shard_from_committee_index(state, slot, spec.CommitteeIndex(index))
