@@ -18,7 +18,7 @@ kill-switch contract.
 from __future__ import annotations
 
 from . import bls, kzg
-from .bls12_381 import g1_from_bytes, g1_to_bytes, pt_to_affine
+from .bls12_381 import g1_from_bytes, g1_to_bytes, pt_from_affine, pt_to_affine
 from .kzg import FP_FIELD, KZGSetup
 
 _setup: KZGSetup | None = None
@@ -53,9 +53,16 @@ def verify_degree_bound(commitment: bytes, degree_proof: bytes, points_count: in
     rejections (both fields arrive from the network inside a block body)."""
     if not bls.bls_active:
         return True
+    if int(points_count) == 0:
+        # Zero-length blob (reference :714-719): the pairing degenerates to
+        # e(proof, G2[0]) == e(commitment, G2[-0]) == e(commitment, G2[0]),
+        # i.e. commitment == degree_proof == G1_SETUP[0]. Check by equality —
+        # kzg.verify_degree_proof rejects k == 0 as out of setup range.
+        ident = identity_commitment()
+        return bytes(commitment) == ident and bytes(degree_proof) == ident
     try:
-        c = g1_from_bytes(bytes(commitment))
-        p = g1_from_bytes(bytes(degree_proof))
+        c = pt_from_affine(FP_FIELD, g1_from_bytes(bytes(commitment)))
+        p = pt_from_affine(FP_FIELD, g1_from_bytes(bytes(degree_proof)))
     except ValueError:
         return False
     return kzg.verify_degree_proof(get_setup(), c, p, int(points_count))
@@ -67,6 +74,10 @@ def commit_to_data(points: list[int]) -> bytes:
     test harness commits to the coefficient form directly)."""
     if not bls.bls_active:
         return b"\xc0" + b"\x00" * 47
+    if len(points) == 0:
+        # Zero-length blob: commitment == degree_proof == G1_SETUP[0]
+        # (reference :714-719 — the degenerate pairing forces both).
+        return identity_commitment()
     return kzg.commit_bytes(get_setup(), [p % kzg.MODULUS for p in points])
 
 

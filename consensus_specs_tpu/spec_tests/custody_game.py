@@ -1,0 +1,430 @@
+"""Dual-mode custody-game operation + epoch tests.
+
+Reference parity: tests/core/pyspec/eth2spec/test/custody_game/ (the
+reference's pytest-only suite, 1,238 LoC — key reveals, early derived secret
+reveals, chunk challenge lifecycle, custody slashings, deadline epoch
+processing), rebuilt against this framework's executable custody overlay
+(specs/custody_game/beacon-chain.md) via the testlib/custody.py scenario
+builders.
+"""
+from ..crypto import bls
+from ..ssz import hash_tree_root
+from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.context import (
+    CUSTODY_GAME,
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from ..testlib.custody import (
+    build_chunk_branch,
+    custody_reveal_signature,
+    get_custody_slashing,
+    get_valid_chunk_challenge,
+    get_valid_chunk_response,
+    get_valid_custody_key_reveal,
+    get_valid_early_derived_secret_reveal,
+)
+from ..testlib.sharding import body_to_summary, build_blob_body, make_blob_points
+from ..testlib.state import next_slots, transition_to
+
+with_custody_game = with_phases([CUSTODY_GAME])
+
+
+def _run_custody_op(spec, state, name, operation, valid=True):
+    yield "pre", state.copy()
+    yield name, operation
+    process = getattr(spec, f"process_{name}")
+    if not valid:
+        expect_assertion_error(lambda: process(state, operation))
+        return
+    process(state, operation)
+    yield "post", state.copy()
+
+
+def _to_custody_period(spec, state, periods=1):
+    """Advance so validator custody periods have elapsed (reveals come due)."""
+    transition_to(
+        spec, state,
+        state.slot + periods * spec.EPOCHS_PER_CUSTODY_PERIOD * spec.SLOTS_PER_EPOCH,
+    )
+
+
+# --- custody key reveals -----------------------------------------------------
+
+@with_custody_game
+@spec_state_test
+def test_custody_key_reveal_success(spec, state):
+    _to_custody_period(spec, state)
+    reveal = get_valid_custody_key_reveal(spec, state, revealer_index=0)
+    pre_next = int(state.validators[0].next_custody_secret_to_reveal)
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal)
+    assert int(state.validators[0].next_custody_secret_to_reveal) == pre_next + 1
+
+
+@with_custody_game
+@always_bls
+@spec_state_test
+def test_custody_key_reveal_success_real_sig(spec, state):
+    _to_custody_period(spec, state)
+    reveal = get_valid_custody_key_reveal(spec, state, revealer_index=1)
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal)
+
+
+@with_custody_game
+@always_bls
+@spec_state_test
+def test_custody_key_reveal_wrong_signature(spec, state):
+    _to_custody_period(spec, state)
+    # a signature over the wrong period's epoch must not count as the reveal
+    reveal = spec.CustodyKeyReveal(
+        revealer_index=0,
+        reveal=custody_reveal_signature(spec, state, 0, period=5),
+    )
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_key_reveal_too_early(spec, state):
+    # at genesis the first custody period has not elapsed yet
+    reveal = get_valid_custody_key_reveal(spec, state, revealer_index=0)
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_key_reveal_double(spec, state):
+    _to_custody_period(spec, state)
+    reveal = get_valid_custody_key_reveal(spec, state, revealer_index=0)
+    spec.process_custody_key_reveal(state, reveal)
+    # only one secret is owed after one period: the second reveal is early
+    reveal2 = get_valid_custody_key_reveal(spec, state, revealer_index=0)
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal2, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_key_reveal_exit_period(spec, state):
+    """An exited validator may (must) deliver the final exit-period reveal."""
+    _to_custody_period(spec, state)
+    validator = state.validators[0]
+    exit_epoch = spec.get_current_epoch(state)
+    validator.exit_epoch = exit_epoch
+    validator.next_custody_secret_to_reveal = spec.get_custody_period_for_validator(
+        spec.ValidatorIndex(0), spec.Epoch(exit_epoch - 1))
+    reveal = get_valid_custody_key_reveal(spec, state, revealer_index=0)
+    yield from _run_custody_op(spec, state, "custody_key_reveal", reveal)
+    assert int(state.validators[0].all_custody_secrets_revealed_epoch) == int(exit_epoch)
+
+
+# --- early derived secret reveals -------------------------------------------
+
+@with_custody_game
+@spec_state_test
+def test_early_derived_secret_reveal_success(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(spec, state, revealed_index=2)
+    pre_balance = int(state.balances[2])
+    yield from _run_custody_op(spec, state, "early_derived_secret_reveal", reveal)
+    # a live custody key leak (epoch >= now + padding) is a full slashing
+    assert state.validators[2].slashed
+    assert int(state.balances[2]) < pre_balance
+
+
+@with_custody_game
+@always_bls
+@spec_state_test
+def test_early_derived_secret_reveal_success_real_sig(spec, state):
+    reveal = get_valid_early_derived_secret_reveal(spec, state, revealed_index=3)
+    yield from _run_custody_op(spec, state, "early_derived_secret_reveal", reveal)
+
+
+@with_custody_game
+@spec_state_test
+def test_early_derived_secret_reveal_randao_penalty(spec, state):
+    """A near-future (RANDAO-only) leak is a penalty, not a slashing, and the
+    secret index is recorded against replays."""
+    epoch = spec.Epoch(spec.get_current_epoch(state) + spec.RANDAO_PENALTY_EPOCHS)
+    reveal = get_valid_early_derived_secret_reveal(spec, state, revealed_index=2, epoch=epoch)
+    pre_balance = int(state.balances[2])
+    yield from _run_custody_op(spec, state, "early_derived_secret_reveal", reveal)
+    assert not state.validators[2].slashed
+    assert int(state.balances[2]) < pre_balance
+    loc = int(epoch) % int(spec.EARLY_DERIVED_SECRET_PENALTY_MAX_FUTURE_EPOCHS)
+    assert 2 in [int(i) for i in state.exposed_derived_secrets[loc]]
+
+
+@with_custody_game
+@spec_state_test
+def test_early_derived_secret_reveal_replay(spec, state):
+    epoch = spec.Epoch(spec.get_current_epoch(state) + spec.RANDAO_PENALTY_EPOCHS)
+    reveal = get_valid_early_derived_secret_reveal(spec, state, revealed_index=2, epoch=epoch)
+    spec.process_early_derived_secret_reveal(state, reveal)
+    yield from _run_custody_op(spec, state, "early_derived_secret_reveal", reveal, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_early_derived_secret_reveal_too_late(spec, state):
+    # epoch already reached: RANDAO value is public, no leak to punish
+    reveal = get_valid_early_derived_secret_reveal(
+        spec, state, revealed_index=2, epoch=spec.get_current_epoch(state))
+    yield from _run_custody_op(spec, state, "early_derived_secret_reveal", reveal, valid=False)
+
+
+# --- chunk challenge lifecycle ----------------------------------------------
+
+def _attested_blob(spec, state, samples_count=17, seed=1):
+    """(attestation, header, points): an attestation whose shard_blob_root
+    commits to a header over `samples_count` samples of deterministic data.
+
+    samples_count=17 -> 136 points -> 2 custody chunks, so non-zero
+    chunk_index challenges are exercisable (POINTS_PER_CUSTODY_CHUNK=128)."""
+    points = make_blob_points(spec, samples_count, seed=seed)
+    body = build_blob_body(spec, points)
+    header = spec.ShardBlobHeader(
+        slot=state.slot,
+        shard=0,
+        builder_index=0,
+        proposer_index=0,
+        body_summary=body_to_summary(spec, body),
+    )
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.shard_blob_root = hash_tree_root(header)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    return attestation, header, points
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_success(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    pre_index = int(state.custody_chunk_challenge_index)
+    yield from _run_custody_op(spec, state, "chunk_challenge", challenge)
+    assert int(state.custody_chunk_challenge_index) == pre_index + 1
+    record = state.custody_chunk_challenge_records[0]
+    assert int(record.responder_index) == int(challenge.responder_index)
+    assert state.validators[challenge.responder_index].withdrawable_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_duplicate(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    spec.process_chunk_challenge(state, challenge)
+    yield from _run_custody_op(spec, state, "chunk_challenge", challenge, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_chunk_index_out_of_range(spec, state):
+    attestation, header, points = _attested_blob(spec, state)
+    n_chunks = (len(points) + spec.POINTS_PER_CUSTODY_CHUNK - 1) // spec.POINTS_PER_CUSTODY_CHUNK
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, header, chunk_index=n_chunks)
+    yield from _run_custody_op(spec, state, "chunk_challenge", challenge, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_non_attester_responder(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    attesters = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)
+    outsider = next(i for i in range(len(state.validators)) if i not in attesters)
+    challenge = get_valid_chunk_challenge(
+        spec, state, attestation, header, responder_index=outsider)
+    yield from _run_custody_op(spec, state, "chunk_challenge", challenge, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_wrong_header(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    header.slot += 1  # no longer the attested root
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    yield from _run_custody_op(spec, state, "chunk_challenge", challenge, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_response_success(spec, state):
+    """The happy path exercises the real Merkle branch verification
+    (is_valid_merkle_branch over CUSTODY_RESPONSE_DEPTH + length mix-in) —
+    live regardless of the BLS switch."""
+    attestation, header, points = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header, chunk_index=1)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    response = get_valid_chunk_response(spec, state, record, points)
+    yield from _run_custody_op(spec, state, "chunk_challenge_response", response)
+    assert state.custody_chunk_challenge_records[0] == spec.CustodyChunkChallengeRecord()
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_response_wrong_chunk_data(spec, state):
+    attestation, header, points = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    tampered = list(points)
+    tampered[0] = (tampered[0] + 1) % spec.MODULUS
+    response = get_valid_chunk_response(spec, state, record, tampered)
+    response.branch = build_chunk_branch(spec, tampered, int(record.chunk_index))
+    yield from _run_custody_op(spec, state, "chunk_challenge_response", response, valid=False)
+
+
+@with_custody_game
+@spec_state_test
+def test_chunk_challenge_response_unknown_challenge(spec, state):
+    attestation, header, points = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    spec.process_chunk_challenge(state, challenge)
+    record = state.custody_chunk_challenge_records[0]
+    response = get_valid_chunk_response(spec, state, record, points)
+    response.challenge_index += 7
+    yield from _run_custody_op(spec, state, "chunk_challenge_response", response, valid=False)
+
+
+# --- custody slashing --------------------------------------------------------
+
+@with_custody_game
+@spec_state_test
+def test_custody_slashing_outcome(spec, state):
+    """Whichever way the custody bit lands for the deterministic data, exactly
+    one of (malefactor, whistleblower) must end up slashed."""
+    attestation, header, points = _attested_blob(spec, state)
+    attesters = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)
+    malefactor_index = min(attesters)
+    whistleblower_index = max(i for i in range(len(state.validators)) if i != malefactor_index)
+    slashing = get_custody_slashing(
+        spec, state, attestation, header, points,
+        spec.ValidatorIndex(malefactor_index), spec.ValidatorIndex(whistleblower_index))
+    bit = spec.compute_custody_bit(slashing.message.malefactor_secret, slashing.message.data)
+    yield "pre", state.copy()
+    yield "custody_slashing", slashing
+    spec.process_custody_slashing(state, slashing)
+    yield "post", state.copy()
+    if bit == 1:
+        assert state.validators[malefactor_index].slashed
+        assert not state.validators[whistleblower_index].slashed
+    else:
+        assert state.validators[whistleblower_index].slashed
+        assert not state.validators[malefactor_index].slashed
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_slashing_wrong_data(spec, state):
+    attestation, header, points = _attested_blob(spec, state)
+    attesters = spec.get_attesting_indices(
+        state, attestation.data, attestation.aggregation_bits)
+    malefactor_index = min(attesters)
+    tampered = list(points)
+    tampered[-1] = (tampered[-1] + 1) % spec.MODULUS  # data_root mismatch
+    slashing = get_custody_slashing(
+        spec, state, attestation, header, tampered,
+        spec.ValidatorIndex(malefactor_index), spec.ValidatorIndex(0))
+    yield from _run_custody_op(spec, state, "custody_slashing", slashing, valid=False)
+
+
+# --- epoch processing: deadlines + final updates -----------------------------
+
+@with_custody_game
+@spec_state_test
+def test_challenge_deadline_slashes_responder(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    spec.process_chunk_challenge(state, challenge)
+    responder = int(state.custody_chunk_challenge_records[0].responder_index)
+    # age the challenge past the response window, then run the deadline sweep
+    state.custody_chunk_challenge_records[0].inclusion_epoch = 0
+    transition_to(
+        spec, state,
+        (int(spec.EPOCHS_PER_CUSTODY_PERIOD) + 1) * int(spec.SLOTS_PER_EPOCH))
+    yield "sub_transition", "meta", "challenge_deadlines"
+    yield "pre", state.copy()
+    spec.process_challenge_deadlines(state)
+    yield "post", state.copy()
+    assert state.validators[responder].slashed
+    assert state.custody_chunk_challenge_records[0] == spec.CustodyChunkChallengeRecord()
+
+
+@with_custody_game
+@spec_state_test
+def test_reveal_deadline_slashes_laggard(spec, state):
+    """Only the validator behind on reveals is slashed by the deadline sweep.
+
+    The state is posed directly (slot set, dutiful validators' reveal counters
+    advanced) — walking there via process_slots would run the sweep at every
+    boundary, which is the behavior under test."""
+    laggard = 5
+    state.slot = spec.Slot(
+        (2 * int(spec.EPOCHS_PER_CUSTODY_PERIOD) + 2) * int(spec.SLOTS_PER_EPOCH))
+    epoch = spec.get_current_epoch(state)
+    for i, validator in enumerate(state.validators):
+        if i != laggard:
+            validator.next_custody_secret_to_reveal = spec.get_custody_period_for_validator(
+                spec.ValidatorIndex(i), epoch)
+    yield "sub_transition", "meta", "reveal_deadlines"
+    yield "pre", state.copy()
+    spec.process_reveal_deadlines(state)
+    yield "post", state.copy()
+    assert state.validators[laggard].slashed
+    assert not any(v.slashed for i, v in enumerate(state.validators) if i != laggard)
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_final_updates_holds_unrevealed_exit(spec, state):
+    validator = state.validators[0]
+    validator.exit_epoch = spec.get_current_epoch(state)
+    validator.withdrawable_epoch = spec.Epoch(int(validator.exit_epoch) + 1)
+    yield "sub_transition", "meta", "custody_final_updates"
+    yield "pre", state.copy()
+    spec.process_custody_final_updates(state)
+    yield "post", state.copy()
+    # secrets still owed: the hold pins withdrawability open-endedly
+    assert state.validators[0].withdrawable_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_final_updates_restores_withdrawable_epoch(spec, state):
+    """Regression (ADVICE r1, high): once challenges clear and every secret is
+    revealed, the withdrawability hold must lift — otherwise every exited
+    validator is permanently unwithdrawable."""
+    validator = state.validators[0]
+    validator.exit_epoch = spec.get_current_epoch(state)
+    reveal_epoch = spec.Epoch(int(validator.exit_epoch) + 1)
+    validator.all_custody_secrets_revealed_epoch = reveal_epoch
+    validator.withdrawable_epoch = spec.FAR_FUTURE_EPOCH  # held by a prior sweep
+    yield "sub_transition", "meta", "custody_final_updates"
+    yield "pre", state.copy()
+    spec.process_custody_final_updates(state)
+    yield "post", state.copy()
+    assert int(state.validators[0].withdrawable_epoch) == (
+        int(reveal_epoch) + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY))
+
+
+@with_custody_game
+@spec_state_test
+def test_custody_final_updates_open_challenge_keeps_hold(spec, state):
+    attestation, header, _ = _attested_blob(spec, state)
+    challenge = get_valid_chunk_challenge(spec, state, attestation, header)
+    spec.process_chunk_challenge(state, challenge)
+    responder = int(state.custody_chunk_challenge_records[0].responder_index)
+    validator = state.validators[responder]
+    validator.exit_epoch = spec.get_current_epoch(state)
+    validator.all_custody_secrets_revealed_epoch = spec.get_current_epoch(state)
+    yield "sub_transition", "meta", "custody_final_updates"
+    yield "pre", state.copy()
+    spec.process_custody_final_updates(state)
+    yield "post", state.copy()
+    assert state.validators[responder].withdrawable_epoch == spec.FAR_FUTURE_EPOCH

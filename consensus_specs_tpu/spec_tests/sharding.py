@@ -1,0 +1,316 @@
+"""Dual-mode sharding operation + epoch tests.
+
+Reference parity: tests/core/pyspec/eth2spec/test/sharding/ (shard-header
+block processing) extended with the confirmation flow, fee market and
+ring-buffer reset, against this framework's executable sharding overlay
+(specs/sharding/beacon-chain.md) via the testlib/sharding.py builders.
+
+The *_real_crypto cases force live BLS + a real (insecure, deterministic)
+KZG trusted setup, exercising the degree-bound pairing and the joint
+builder+proposer FastAggregateVerify — the paths the kill-switch otherwise
+stubs (ADVICE r1: live-crypto-only bugs need live-crypto tests).
+"""
+from ..crypto import bls, kzg, kzg_shim
+from ..ssz import hash_tree_root
+from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.context import (
+    SHARDING,
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from ..testlib.sharding import (
+    arm_shard_cells,
+    build_signed_shard_blob_header,
+    register_builder,
+    shard_for_committee_index,
+)
+from ..testlib.state import next_slots
+
+with_sharding = with_phases([SHARDING])
+
+_TEST_SETUP = None
+
+
+def _install_test_setup():
+    """Process-global deterministic KZG setup, built once (pure-Python MSMs)."""
+    global _TEST_SETUP
+    if _TEST_SETUP is None:
+        _TEST_SETUP = kzg.insecure_test_setup(16)
+    kzg_shim.use_setup(_TEST_SETUP)
+
+
+def _ready_state(spec, state):
+    """Advance off the genesis slot and arm the shard ring-buffer cells."""
+    next_slots(spec, state, 1)
+    arm_shard_cells(spec, state)
+    register_builder(spec, state)
+
+
+def _run_header_op(spec, state, signed_header, valid=True):
+    yield "pre", state.copy()
+    yield "shard_header", signed_header
+    if not valid:
+        expect_assertion_error(lambda: spec.process_shard_header(state, signed_header))
+        return
+    spec.process_shard_header(state, signed_header)
+    yield "post", state.copy()
+
+
+def _pending_headers(spec, state, slot, shard):
+    work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
+    assert work.status.selector == spec.SHARD_WORK_PENDING
+    return work.status.value
+
+
+# --- process_shard_header ----------------------------------------------------
+
+@with_sharding
+@spec_state_test
+def test_shard_header_success(spec, state):
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard)
+    pre_builder_balance = int(state.blob_builder_balances[0])
+    yield from _run_header_op(spec, state, signed)
+    headers = _pending_headers(spec, state, state.slot, shard)
+    assert len(headers) == 2  # the armed empty-commitment placeholder + ours
+    assert headers[1].attested.root == hash_tree_root(signed.message)
+    # base fee burned from the builder (priority fee 0 in this scenario)
+    samples = int(signed.message.body_summary.commitment.samples_count)
+    base_fee = int(state.shard_sample_price) * samples
+    assert int(state.blob_builder_balances[0]) == pre_builder_balance - base_fee
+
+
+@with_sharding
+@always_bls
+@spec_state_test
+def test_shard_header_success_real_crypto(spec, state):
+    """Live joint-signature FastAggregateVerify + live degree-bound pairing."""
+    _install_test_setup()
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=1)
+    yield from _run_header_op(spec, state, signed)
+
+
+@with_sharding
+@always_bls
+@spec_state_test
+def test_shard_header_zero_blob_real_crypto(spec, state):
+    """Regression (ADVICE r1, medium): zero-length blobs carry the identity
+    commitment pair and must verify under LIVE crypto (the kill-switch used
+    to mask a verify_degree_bound(k=0) rejection)."""
+    _install_test_setup()
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=0)
+    assert bytes(signed.message.body_summary.commitment.point) == kzg_shim.identity_commitment()
+    yield from _run_header_op(spec, state, signed)
+
+
+@with_sharding
+@always_bls
+@spec_state_test
+def test_shard_header_zero_blob_wrong_commitment_real_crypto(spec, state):
+    _install_test_setup()
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=0)
+    # a commitment to actual data cannot claim zero length
+    signed.message.body_summary.commitment.point = spec.BLSCommitment(
+        kzg.commit_bytes(kzg_shim.get_setup(), [7]))
+    from ..testlib.sharding import sign_shard_blob_header
+
+    signed.signature = sign_shard_blob_header(spec, state, signed.message)
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@always_bls
+@spec_state_test
+def test_shard_header_wrong_degree_proof_real_crypto(spec, state):
+    _install_test_setup()
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=1)
+    # degree proof for a looser bound (2 samples' worth) must be rejected
+    points = [int(p) for p in _body.data]
+    signed.message.body_summary.degree_proof = spec.BLSCommitment(
+        kzg.commit_bytes(
+            kzg_shim.get_setup(),
+            [0] * (kzg_shim.get_setup().max_degree + 1 - 2 * len(points)) + points,
+        ))
+    from ..testlib.sharding import sign_shard_blob_header
+
+    signed.signature = sign_shard_blob_header(spec, state, signed.message)
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@always_bls
+@spec_state_test
+def test_shard_header_invalid_signature_real_crypto(spec, state):
+    _install_test_setup()
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(
+        spec, state, shard=shard, samples_count=1, valid_signature=False)
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@spec_state_test
+def test_shard_header_genesis_slot(spec, state):
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard)
+    signed.message.slot = spec.Slot(0)  # genesis slot is never attestable
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@spec_state_test
+def test_shard_header_future_slot(spec, state):
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard)
+    signed.message.slot = state.slot + 1
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@spec_state_test
+def test_shard_header_duplicate(spec, state):
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard)
+    spec.process_shard_header(state, signed)
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@spec_state_test
+def test_shard_header_wrong_proposer(spec, state):
+    _ready_state(spec, state)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard)
+    signed.message.proposer_index = spec.ValidatorIndex(
+        (int(signed.message.proposer_index) + 1) % len(state.validators))
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+@with_sharding
+@spec_state_test
+def test_shard_header_builder_cannot_cover_fee(spec, state):
+    next_slots(spec, state, 1)
+    arm_shard_cells(spec, state)
+    register_builder(spec, state, balance=0)
+    shard = shard_for_committee_index(spec, state, state.slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=1)
+    yield from _run_header_op(spec, state, signed, valid=False)
+
+
+# --- process_attested_shard_work --------------------------------------------
+
+def _attest_to_header(spec, state, header_root, slot, index=0, fraction=(1, 1)):
+    attestation = get_valid_attestation(spec, state, slot=slot, index=index, signed=False)
+    num, den = fraction
+    bits = attestation.aggregation_bits
+    for i in range(len(bits)):
+        bits[i] = (i * den) < (len(bits) * num)
+    attestation.data.shard_blob_root = header_root
+    sign_attestation(spec, state, attestation)
+    return attestation
+
+
+@with_sharding
+@spec_state_test
+def test_attested_shard_work_confirms(spec, state):
+    _ready_state(spec, state)
+    slot = state.slot
+    shard = shard_for_committee_index(spec, state, slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=1)
+    spec.process_shard_header(state, signed)
+    header_root = hash_tree_root(signed.message)
+    attestation = _attest_to_header(spec, state, header_root, slot)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield "pre", state.copy()
+    yield "attestation", attestation
+    spec.process_attested_shard_work(state, attestation)
+    yield "post", state.copy()
+    work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
+    assert work.status.selector == spec.SHARD_WORK_CONFIRMED
+    assert work.status.value.root == header_root
+
+
+@with_sharding
+@spec_state_test
+def test_attested_shard_work_below_quorum_stays_pending(spec, state):
+    _ready_state(spec, state)
+    slot = state.slot
+    shard = shard_for_committee_index(spec, state, slot)
+    signed, _body = build_signed_shard_blob_header(spec, state, shard=shard, samples_count=1)
+    spec.process_shard_header(state, signed)
+    header_root = hash_tree_root(signed.message)
+    attestation = _attest_to_header(spec, state, header_root, slot, fraction=(1, 2))
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield "pre", state.copy()
+    spec.process_attested_shard_work(state, attestation)
+    yield "post", state.copy()
+    work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
+    assert work.status.selector == spec.SHARD_WORK_PENDING
+    # votes accumulated on the pending header for later re-inclusion
+    assert int(work.status.value[1].weight) > 0
+
+
+@with_sharding
+@spec_state_test
+def test_attested_shard_work_empty_root_unconfirms(spec, state):
+    """A quorum for the armed empty-commitment placeholder resolves the cell
+    to UNCONFIRMED (nobody built a blob worth confirming)."""
+    _ready_state(spec, state)
+    slot = state.slot
+    shard = shard_for_committee_index(spec, state, slot)
+    empty_root = _pending_headers(spec, state, slot, shard)[0].attested.root
+    attestation = _attest_to_header(spec, state, empty_root, slot)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield "pre", state.copy()
+    spec.process_attested_shard_work(state, attestation)
+    yield "post", state.copy()
+    work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
+    assert work.status.selector == spec.SHARD_WORK_UNCONFIRMED
+
+
+# --- epoch processing: price update + ring reset -----------------------------
+
+@with_sharding
+@spec_state_test
+def test_shard_sample_price_update_bounds(spec, state):
+    state.shard_sample_price = spec.Gwei(int(spec.MIN_SAMPLE_PRICE))
+    yield "sub_transition", "meta", "shard_sample_price_update"
+    yield "pre", state.copy()
+    spec.process_shard_sample_price_update(state)
+    assert int(state.shard_sample_price) >= int(spec.MIN_SAMPLE_PRICE)
+    yield "post", state.copy()
+
+
+@with_sharding
+@spec_state_test
+def test_reset_pending_shard_work_arms_next_epoch(spec, state):
+    next_slots(spec, state, 1)
+    yield "sub_transition", "meta", "reset_pending_shard_work"
+    yield "pre", state.copy()
+    spec.reset_pending_shard_work(state)
+    yield "post", state.copy()
+    next_epoch = spec.get_current_epoch(state) + 1
+    start_slot = spec.compute_start_slot_at_epoch(next_epoch)
+    committees_per_slot = spec.get_committee_count_per_slot(state, next_epoch)
+    for slot in range(int(start_slot), int(start_slot) + int(spec.SLOTS_PER_EPOCH)):
+        buffer_index = slot % int(spec.SHARD_STATE_MEMORY_SLOTS)
+        armed = sum(
+            1 for work in state.shard_buffer[buffer_index]
+            if work.status.selector == spec.SHARD_WORK_PENDING
+        )
+        assert armed == int(committees_per_slot)

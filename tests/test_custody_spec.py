@@ -1,0 +1,20 @@
+"""Spec-level custody-game suite (dual-mode bodies from spec_tests/custody_game).
+
+BLS defaults off for speed (reference custody tests run pytest-only with the
+same kill-switch); the *_real_sig cases force it on via @always_bls, covering
+every signature path with live crypto at least once (ADVICE r1, low).
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls
+
+
+@pytest.fixture(autouse=True)
+def _fast_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+from consensus_specs_tpu.spec_tests.custody_game import *  # noqa: E402,F401,F403

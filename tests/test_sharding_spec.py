@@ -1,0 +1,20 @@
+"""Spec-level sharding suite (dual-mode bodies from spec_tests/sharding).
+
+BLS defaults off for speed; the *_real_crypto cases force live BLS and a real
+KZG setup via @always_bls + kzg_shim.use_setup (ADVICE r1, low).
+"""
+import pytest
+
+from consensus_specs_tpu.crypto import bls, kzg_shim
+
+
+@pytest.fixture(autouse=True)
+def _fast_bls():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+    kzg_shim.use_setup(None)
+
+
+from consensus_specs_tpu.spec_tests.sharding import *  # noqa: E402,F401,F403
