@@ -1,12 +1,21 @@
-"""Headline benchmark: mainnet-preset 1M-validator `process_epoch` wall-clock.
+"""Headline benchmark — BOTH BASELINE.md north stars, one JSON line.
 
-Target (BASELINE.md north star): < 2 s on a TPU chip for the full epoch
-registry sweep (justification, inactivity, rewards/penalties, registry churn,
-slashings, hysteresis, resets, historical-batch merkle). The reference
-publishes no numbers (BASELINE.json `published: {}`), so `vs_baseline` is the
-speedup against that 2 s target: 2.0 / measured.
+1. `bls_verify_throughput` (the headline metric/value): aggregate BLS
+   signature verifications per second on one chip — batched
+   e(pk_i, H(m_i))·e(-G1, sig_i) == 1 checks through the RNS pairing kernels
+   (ops/bls12_jax.py over ops/fp_rns.py). Target >= 100k/s (BASELINE.json);
+   `vs_baseline` is measured/target.
+2. `extra.process_epoch_1m_s`: mainnet-preset 1M-validator altair
+   `process_epoch` device wall-clock (target < 2 s;
+   `extra.epoch_vs_baseline` = 2.0/measured).
 
-Prints exactly one JSON line.
+The reference publishes no numbers (BASELINE.json `published: {}`), so both
+baselines are the BASELINE.json targets. Host prep (decompression,
+hash-to-curve) is excluded from the BLS timed region: pubkeys live
+decompressed in the registry and messages hash once per slot, so the pairing
+is the marginal per-verification cost.
+
+Prints exactly one JSON line on stdout (progress notes on stderr).
 """
 from __future__ import annotations
 
@@ -15,11 +24,13 @@ import os
 import sys
 import time
 
-N = int(os.environ.get("BENCH_VALIDATORS", 1_048_576))
-TARGET_S = 2.0
+N_VALIDATORS = int(os.environ.get("BENCH_VALIDATORS", 1_048_576))
+N_BLS = int(os.environ.get("BENCH_BLS_N", 2048))
+BLS_TARGET = 100_000.0
+EPOCH_TARGET_S = 2.0
 
 
-def main() -> None:
+def bench_epoch() -> float:
     import jax
 
     from consensus_specs_tpu.compiler import get_spec
@@ -28,14 +39,13 @@ def main() -> None:
     from consensus_specs_tpu.engine.synthetic import synthetic_epoch_state
 
     cfg = EpochConfig.from_spec(get_spec("altair", "mainnet"))
-    state = synthetic_epoch_state(cfg, n=N)
-    # donated buffers: keep a template to refresh inputs between timed runs
+    state = synthetic_epoch_state(cfg, n=N_VALIDATORS)
     fn = make_epoch_fn(cfg)
 
     t0 = time.time()
     out, _ = fn(state)
     jax.block_until_ready(out.balances)
-    print(f"# compile+first: {time.time() - t0:.1f}s on {jax.devices()[0]}", file=sys.stderr)
+    print(f"# epoch compile+first: {time.time() - t0:.1f}s", file=sys.stderr)
 
     times = []
     for _ in range(5):
@@ -45,14 +55,54 @@ def main() -> None:
         jax.block_until_ready(out2.balances)
         times.append(time.time() - t0)
         out = out2
-    med = sorted(times)[len(times) // 2]
+    return sorted(times)[len(times) // 2]
+
+
+def bench_bls() -> tuple[float, float]:
+    """(verifications/sec, compile_s) for a batch of N_BLS pairing checks."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from consensus_specs_tpu.crypto.bls_jax import bench_pairing_args
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    args = bench_pairing_args(N_BLS)
+    t0 = _time.time()
+    ok = K.pairing_check_batch(*args)
+    ok.block_until_ready()
+    compile_s = _time.time() - t0
+    assert bool(np.asarray(ok).all()), "batched verification rejected valid signatures"
+    print(f"# bls compile+first: {compile_s:.1f}s", file=sys.stderr)
+
+    times = []
+    for _ in range(3):
+        t0 = _time.time()
+        K.pairing_check_batch(*args).block_until_ready()
+        times.append(_time.time() - t0)
+    return N_BLS / min(times), compile_s
+
+
+def main() -> None:
+    import jax
+
+    vps, compile_s = bench_bls()
+    epoch_s = bench_epoch()
     print(
         json.dumps(
             {
-                "metric": f"mainnet_altair_process_epoch_{N}_validators",
-                "value": round(med, 4),
-                "unit": "s",
-                "vs_baseline": round(TARGET_S / med, 2),
+                "metric": "bls_verify_throughput",
+                "value": round(vps, 1),
+                "unit": "verifications/sec/chip",
+                "vs_baseline": round(vps / BLS_TARGET, 4),
+                "extra": {
+                    "bls_batch": N_BLS,
+                    "bls_compile_s": round(compile_s, 1),
+                    "process_epoch_1m_s": round(epoch_s, 4),
+                    "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
+                    "device": str(jax.devices()[0]),
+                },
             }
         )
     )

@@ -27,49 +27,12 @@ DISTINCT = 8  # host-signed distinct triples, tiled to N
 
 def main():
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from consensus_specs_tpu.crypto import bls12_381 as oracle
-    from consensus_specs_tpu.crypto import bls_sig
-    from consensus_specs_tpu.crypto.hash_to_curve import hash_to_curve_g2
+    from consensus_specs_tpu.crypto.bls_jax import bench_pairing_args
     from consensus_specs_tpu.ops import bls12_jax as K
-    from consensus_specs_tpu.ops.fp_jax import ints_to_mont_batch
 
-    # --- host prep: DISTINCT triples -> affine coordinates ---
-    g1_neg = (oracle.G1_GEN_AFF[0], (-oracle.G1_GEN_AFF[1]) % oracle.P)
-    pks, hms, sigs = [], [], []
-    for i in range(DISTINCT):
-        sk = 1000 + i
-        msg = b"bench message %d" % i
-        sig = bls_sig.Sign(sk, msg)
-        pks.append(oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, sk)))
-        hms.append(hash_to_curve_g2(msg))
-        sigs.append(oracle.g2_from_bytes(bytes(sig)))
-
-    def tile(arr):
-        reps = (N + DISTINCT - 1) // DISTINCT
-        return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:N]
-
-    # e(pk_i, H(m_i)) * e(-G1, sig_i) == 1  (P in G1, Q in G2)
-    px = tile(ints_to_mont_batch([p[0] for p in pks]))
-    py = tile(ints_to_mont_batch([p[1] for p in pks]))
-    qx_re = tile(ints_to_mont_batch([h[0][0] for h in hms]))
-    qx_im = tile(ints_to_mont_batch([h[0][1] for h in hms]))
-    qy_re = tile(ints_to_mont_batch([h[1][0] for h in hms]))
-    qy_im = tile(ints_to_mont_batch([h[1][1] for h in hms]))
-    p2x = tile(ints_to_mont_batch([g1_neg[0]] * DISTINCT))
-    p2y = tile(ints_to_mont_batch([g1_neg[1]] * DISTINCT))
-    q2x_re = tile(ints_to_mont_batch([s[0][0] for s in sigs]))
-    q2x_im = tile(ints_to_mont_batch([s[0][1] for s in sigs]))
-    q2y_re = tile(ints_to_mont_batch([s[1][0] for s in sigs]))
-    q2y_im = tile(ints_to_mont_batch([s[1][1] for s in sigs]))
-
-    dev = jax.device_put
-    args = (
-        (dev(qx_re), dev(qx_im)), (dev(qy_re), dev(qy_im)), dev(px), dev(py),
-        (dev(q2x_re), dev(q2x_im)), (dev(q2y_re), dev(q2y_im)), dev(p2x), dev(p2y),
-    )
+    args = bench_pairing_args(N, DISTINCT)
 
     t0 = time.time()
     ok = K.pairing_check_batch(*args)

@@ -5,9 +5,19 @@ the global `bls_active` kill-switch (:6), backend selection (:17-30), the
 `only_with_bls` decorator (:33-44) and the operation surface (:47-110).
 
 Backends:
-- "py"  : pure-Python oracle (crypto/bls_sig.py) — correctness reference.
-- "jax" : batched device kernels (ops/bls_jax.py) for bulk verification;
-          falls back to "py" per-op until the kernel set is complete.
+- "py"  : pure-Python oracle (crypto/bls_sig.py) — correctness reference
+          (the reference's py_ecc role, utils/bls.py:25-30).
+- "jax" : batched device pairing (crypto/bls_jax.py over ops/bls12_jax.py)
+          for Verify/FastAggregateVerify — the milagro role (:17-22), built
+          on the RNS/MXU field. Sign/aggregate/codec ops stay on the host
+          oracle in either backend.
+
+Deferred batching: `with deferred_verification():` queues every
+verification (optimistically returning True) and flushes the whole set in
+ONE device launch at exit, raising BLSVerificationError if any check fails
+— the SURVEY.md §7 state_transition stance (collect triples, verify once,
+AND-reduce). Works under either backend ("py" flushes through the oracle),
+so the spec markdown's inline `assert bls.Verify(...)` lines stay untouched.
 
 When `bls_active` is False every operation returns a stub success/zero value,
 letting the spec-test matrix run fast without real crypto — the same contract
@@ -36,10 +46,81 @@ def use_py():
 
 
 def use_jax():
-    raise NotImplementedError(
-        "jax BLS backend not wired up yet (ops/bls_jax.py pending); "
-        "the pure-Python backend is active"
-    )
+    """Route Verify/FastAggregateVerify through the batched device pairing."""
+    global _backend
+    _backend = "jax"
+
+
+class BLSVerificationError(AssertionError):
+    """Raised at deferred-batch flush when one or more checks failed.
+
+    Subclasses AssertionError so spec-level consumers (expect_assertion_error,
+    fork-choice on_block try/except) treat a deferred failure exactly like an
+    inline `assert bls.Verify(...)` failure."""
+
+
+_deferred_queue = None  # None = inline mode; list = queueing
+
+
+class deferred_verification:
+    """Context manager: queue all signature checks, verify once at exit."""
+
+    def __enter__(self):
+        global _deferred_queue
+        if _deferred_queue is not None:  # not assert: -O must not skip this
+            raise RuntimeError("deferred_verification cannot nest")
+        _deferred_queue = []
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        global _deferred_queue
+        queue, _deferred_queue = _deferred_queue, None
+        if exc_type is not None:
+            return False  # propagate; skip verification of a failed body
+        if queue:
+            results = _flush_deferred(queue)
+            if not all(results):
+                bad = [i for i, ok in enumerate(results) if not ok]
+                raise BLSVerificationError(
+                    f"deferred batch verification failed for checks {bad}"
+                )
+        return False
+
+
+def _flush_deferred(queue):
+    """queue: list of ("kind", args) tuples -> list[bool]."""
+    from . import bls_jax
+
+    if _backend == "jax":
+        checks = []
+        results = [None] * len(queue)
+        for i, (kind, args) in enumerate(queue):
+            if kind == "verify":
+                checks.append(bls_jax.make_verify_check(*args))
+            elif kind == "fast_aggregate":
+                checks.append(bls_jax.make_fast_aggregate_check(*args))
+            else:  # aggregate_verify: host fallback (distinct-message multi-pairing)
+                checks.append(None)
+                results[i] = _py.AggregateVerify(*args)
+        dev = bls_jax.run_checks(checks)
+        return [dev[i] if r is None else r for i, r in enumerate(results)]
+    dispatch = {
+        "verify": _py.Verify,
+        "fast_aggregate": _py.FastAggregateVerify,
+        "aggregate_verify": _py.AggregateVerify,
+    }
+    return [dispatch[kind](*args) for kind, args in queue]
+
+
+def _check(kind, args, py_fn):
+    """Common path for the three verification ops: queue when deferring,
+    else dispatch to the active backend."""
+    if _deferred_queue is not None:
+        _deferred_queue.append((kind, args))
+        return True
+    if _backend == "jax":
+        return bool(_flush_deferred([(kind, args)])[0])
+    return py_fn(*args)
 
 
 def backend() -> str:
@@ -60,17 +141,21 @@ def only_with_bls(alt_return=None):
 
 @only_with_bls(alt_return=True)
 def Verify(pubkey, message, signature) -> bool:
-    return _py.Verify(pubkey, message, signature)
+    return _check("verify", (pubkey, message, signature), _py.Verify)
 
 
 @only_with_bls(alt_return=True)
 def AggregateVerify(pubkeys, messages, signature) -> bool:
-    return _py.AggregateVerify(pubkeys, messages, signature)
+    return _check(
+        "aggregate_verify", (list(pubkeys), list(messages), signature),
+        _py.AggregateVerify)
 
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pubkeys, message, signature) -> bool:
-    return _py.FastAggregateVerify(pubkeys, message, signature)
+    return _check(
+        "fast_aggregate", (list(pubkeys), message, signature),
+        _py.FastAggregateVerify)
 
 
 @only_with_bls(alt_return=STUB_SIGNATURE)

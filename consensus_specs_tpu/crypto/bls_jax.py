@@ -1,0 +1,188 @@
+"""Device-batched BLS verification: the bridge between the BLS shim and the
+TPU pairing kernels.
+
+Reference parity: the role milagro plays behind eth2spec/utils/bls.py
+(:17-22 use_milagro — the fast backend CI and all vector generation run on).
+Here the fast backend is ops/bls12_jax.py's batched pairing over the RNS
+field (ops/fp_rns.py), and the unit of work is a BATCH of signature checks:
+one `pairing_check_batch` launch verifies every queued (pubkey, message,
+signature) triple of a block/epoch at once (SURVEY.md §7 deferred-batch
+stance).
+
+Host side (this module): decompression, hash-to-curve, G1 aggregation for
+FastAggregateVerify, padding to bucketed batch shapes (so jit caches stay
+small), and the bool readout. Device side: two Miller loops + shared final
+exponentiation per item.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import bls12_381 as oracle
+from .hash_to_curve import hash_to_curve_g2
+from .bls12_381 import g1_from_bytes, g2_from_bytes
+
+# known-valid padding item: e(G1, G2) * e(-G1, G2) == 1
+_G1 = oracle.G1_GEN_AFF
+_NEG_G1 = (_G1[0], (-_G1[1]) % oracle.P)
+_G2 = oracle.G2_GEN_AFF
+
+_MIN_BATCH = 8
+
+
+def _bucket(n: int) -> int:
+    b = _MIN_BATCH
+    while b < n:
+        b *= 2
+    return b
+
+
+def _device_check(p1s, q1s, p2s, q2s) -> np.ndarray:
+    """e(p1_i, q1_i) * e(p2_i, q2_i) == 1 per item; affine int coords in,
+    bool array out. Pads to the next power-of-two bucket."""
+    import jax
+
+    from ..ops import bls12_jax as K
+
+    n = len(p1s)
+    b = _bucket(n)
+    pad = b - n
+    p1s = list(p1s) + [_G1] * pad
+    q1s = list(q1s) + [_G2] * pad
+    p2s = list(p2s) + [_NEG_G1] * pad
+    q2s = list(q2s) + [_G2] * pad
+
+    enc = K.F.ints_to_mont_batch
+
+    def g1_coords(pts):
+        return enc([p[0] for p in pts]), enc([p[1] for p in pts])
+
+    def g2_coords(pts):
+        x = (enc([p[0][0] for p in pts]), enc([p[0][1] for p in pts]))
+        y = (enc([p[1][0] for p in pts]), enc([p[1][1] for p in pts]))
+        return x, y
+
+    px, py = g1_coords(p1s)
+    qx, qy = g2_coords(q1s)
+    p2x, p2y = g1_coords(p2s)
+    q2x, q2y = g2_coords(q2s)
+    ok = K.pairing_check_batch(qx, qy, px, py, q2x, q2y, p2x, p2y)
+    return np.asarray(jax.device_get(ok))[:n]
+
+
+class QueuedCheck:
+    """One deferred signature check, normalized to the two-pairing form."""
+
+    __slots__ = ("p1", "q1", "p2", "q2")
+
+    def __init__(self, p1, q1, p2, q2):
+        self.p1, self.q1, self.p2, self.q2 = p1, q1, p2, q2
+
+
+def _decompress_inputs(pubkey: bytes, message: bytes, signature: bytes):
+    """(pk_aff, H(m)_aff, sig_aff) or None if any input is invalid."""
+    try:
+        pk = g1_from_bytes(bytes(pubkey))
+        sig = g2_from_bytes(bytes(signature))
+    except ValueError:
+        return None
+    if pk is None or sig is None:  # point at infinity is never valid here
+        return None
+    hm = hash_to_curve_g2(bytes(message))
+    return pk, hm, sig
+
+
+def make_verify_check(pubkey, message, signature) -> QueuedCheck | None:
+    """Verify(pk, m, sig) as a QueuedCheck (None = statically invalid)."""
+    dec = _decompress_inputs(pubkey, message, signature)
+    if dec is None:
+        return None
+    pk, hm, sig = dec
+    return QueuedCheck(pk, hm, _NEG_G1, sig)
+
+
+def make_fast_aggregate_check(pubkeys, message, signature) -> QueuedCheck | None:
+    """FastAggregateVerify: aggregate the pubkeys on host, then one check."""
+    if len(pubkeys) == 0:
+        return None
+    acc = None
+    for pk in pubkeys:
+        try:
+            aff = g1_from_bytes(bytes(pk))
+        except ValueError:
+            return None
+        if aff is None:
+            return None
+        pt = oracle.pt_from_affine(oracle.FP_FIELD, aff)
+        acc = pt if acc is None else oracle.pt_add(oracle.FP_FIELD, acc, pt)
+    agg = oracle.pt_to_affine(oracle.FP_FIELD, acc)
+    if agg is None:
+        return None
+    try:
+        sig = g2_from_bytes(bytes(signature))
+    except ValueError:
+        return None
+    if sig is None:
+        return None
+    hm = hash_to_curve_g2(bytes(message))
+    return QueuedCheck(agg, hm, _NEG_G1, sig)
+
+
+def run_checks(checks) -> np.ndarray:
+    """Execute a list of QueuedCheck | None on device; None -> False."""
+    live = [(i, c) for i, c in enumerate(checks) if c is not None]
+    out = np.zeros(len(checks), dtype=bool)
+    if live:
+        res = _device_check(
+            [c.p1 for _, c in live],
+            [c.q1 for _, c in live],
+            [c.p2 for _, c in live],
+            [c.q2 for _, c in live],
+        )
+        for (i, _), ok in zip(live, res):
+            out[i] = bool(ok)
+    return out
+
+
+def bench_pairing_args(n: int, distinct: int = 8):
+    """Device-ready args for `ops.bls12_jax.pairing_check_batch`: `n` valid
+    (pubkey, H(m), signature) triples tiled from `distinct` host-signed ones.
+
+    Single source of truth for the benchmark input packing (bench.py and
+    benches/bls_verify_bench.py) so the positional pairing argument order
+    lives in one place next to the shim's own packing above."""
+    import jax
+    import numpy as np
+
+    from ..ops import bls12_jax as K
+    from .bls_sig import Sign
+    from .hash_to_curve import hash_to_curve_g2
+
+    enc = K.F.ints_to_mont_batch
+    pks, hms, sigs = [], [], []
+    for i in range(distinct):
+        sk = 1000 + i
+        msg = b"bench message %d" % i
+        sigs.append(g2_from_bytes(bytes(Sign(sk, msg))))
+        pks.append(
+            oracle.pt_to_affine(
+                oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, sk)
+            )
+        )
+        hms.append(hash_to_curve_g2(msg))
+
+    def tile(arr):
+        reps = (n + distinct - 1) // distinct
+        return np.tile(arr, (reps,) + (1,) * (arr.ndim - 1))[:n]
+
+    dev = jax.device_put
+    return (
+        (dev(tile(enc([h[0][0] for h in hms]))), dev(tile(enc([h[0][1] for h in hms])))),
+        (dev(tile(enc([h[1][0] for h in hms]))), dev(tile(enc([h[1][1] for h in hms])))),
+        dev(tile(enc([p[0] for p in pks]))),
+        dev(tile(enc([p[1] for p in pks]))),
+        (dev(tile(enc([s[0][0] for s in sigs]))), dev(tile(enc([s[0][1] for s in sigs])))),
+        (dev(tile(enc([s[1][0] for s in sigs]))), dev(tile(enc([s[1][1] for s in sigs])))),
+        dev(tile(enc([_NEG_G1[0]] * distinct))),
+        dev(tile(enc([_NEG_G1[1]] * distinct))),
+    )

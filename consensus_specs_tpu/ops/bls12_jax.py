@@ -40,19 +40,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..crypto import bls12_381 as oracle
-from . import fp_jax as F
-from .fp_jax import (
-    ONE_MONT,
-    P,
-    fp_add,
-    fp_inv,
-    fp_mont_mul,
-    fp_mont_sqr,
-    fp_neg,
-    fp_sub,
-    fp_sum_stack,
-    to_mont,
-)
+from . import fp_jax, fp_rns
+
+# Swappable field backend: every field op goes through `F.<op>` resolved at
+# call time, so one tower/pairing implementation runs on either the
+# positional-limb kernels (fp_jax: canonical 24x16-bit, CPU-friendly) or the
+# RNS kernels (fp_rns: 64-channel residues, the TPU/MXU path). The two
+# representations differ in trailing dim (24 vs 64), so jit caches never
+# collide across a switch.
+F = fp_rns
+
+FIELD_BACKENDS = {"limb": fp_jax, "rns": fp_rns}
+
+
+def set_field_backend(name: str) -> None:
+    global F
+    F = FIELD_BACKENDS[name]
+
+
+def field_backend() -> str:
+    return next(k for k, v in FIELD_BACKENDS.items() if v is F)
+
+
+P = fp_jax.P
+assert fp_rns.P == P
 
 X_PARAM = oracle.X_PARAM
 ABS_X = abs(X_PARAM)
@@ -72,24 +83,24 @@ def f2_zero_like(x):
 
 
 def f2_one_like(x):
-    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), x[0].shape).astype(jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(F.ONE_MONT), x[0].shape).astype(x[0].dtype)
     return (one, jnp.zeros_like(one))
 
 
 def f2_add(x, y):
-    return (fp_add(x[0], y[0]), fp_add(x[1], y[1]))
+    return (F.fp_add(x[0], y[0]), F.fp_add(x[1], y[1]))
 
 
 def f2_sub(x, y):
-    return (fp_sub(x[0], y[0]), fp_sub(x[1], y[1]))
+    return (F.fp_sub(x[0], y[0]), F.fp_sub(x[1], y[1]))
 
 
 def f2_neg(x):
-    return (fp_neg(x[0]), fp_neg(x[1]))
+    return (F.fp_neg(x[0]), F.fp_neg(x[1]))
 
 
 def f2_conj(x):
-    return (x[0], fp_neg(x[1]))
+    return (x[0], F.fp_neg(x[1]))
 
 
 def _bcast2(x, y):
@@ -98,44 +109,56 @@ def _bcast2(x, y):
     return (a, c), (b, d)
 
 
-def f2_mul(x, y):
-    """Karatsuba with the 3 Fp products stacked into one kernel call."""
+def f2_mul_wide(x, y):
+    """Karatsuba, 3 stacked Fp products, WIDE result (lazy reduction): the
+    output components are unreduced double-Montgomery-scale values that may
+    be summed/xi-folded before one fp_mont_reduce per final coefficient.
+    Under the positional-limb backend wide == reduced and this is the plain
+    Fp2 multiply."""
     x, y = _bcast2(x, y)
     a, b = x
     c, d = y
-    A = jnp.stack([a, b, fp_add(a, b)])
-    B = jnp.stack([c, d, fp_add(c, d)])
-    M = fp_mont_mul(A, B)
+    A = jnp.stack([a, b, F.fp_add(a, b)])
+    B = jnp.stack([c, d, F.fp_add(c, d)])
+    M = F.fp_mul_wide(A, B)
     ac, bd, t = M[0], M[1], M[2]
-    return (fp_sub(ac, bd), fp_sub(fp_sub(t, ac), bd))
+    return (F.fp_sub(ac, bd), F.fp_sub(F.fp_sub(t, ac), bd))
+
+
+def f2_reduce(x):
+    return (F.fp_mont_reduce(x[0]), F.fp_mont_reduce(x[1]))
+
+
+def f2_mul(x, y):
+    return f2_reduce(f2_mul_wide(x, y))
 
 
 def f2_sqr(x):
     a, b = x
-    A = jnp.stack([fp_add(a, b), fp_add(a, a)])
-    B = jnp.stack([fp_sub(a, b), b])
-    M = fp_mont_mul(A, B)
+    A = jnp.stack([F.fp_add(a, b), F.fp_add(a, a)])
+    B = jnp.stack([F.fp_sub(a, b), b])
+    M = F.fp_mont_reduce(F.fp_mul_wide(A, B))
     return (M[0], M[1])
 
 
 def f2_mul_fp(x, s):
     S = jnp.stack(jnp.broadcast_arrays(*((s,) * 2)))
-    M = fp_mont_mul(jnp.stack(jnp.broadcast_arrays(x[0], x[1])), S)
+    M = F.fp_mont_mul(jnp.stack(jnp.broadcast_arrays(x[0], x[1])), S)
     return (M[0], M[1])
 
 
 def f2_mul_xi(x):
     """multiply by xi = 1 + u: (a+bu)(1+u) = (a-b) + (a+b)u."""
     a, b = x
-    return (fp_sub(a, b), fp_add(a, b))
+    return (F.fp_sub(a, b), F.fp_add(a, b))
 
 
 def f2_inv(x):
     a, b = x
-    norm = fp_add(fp_mont_sqr(a), fp_mont_sqr(b))
-    ninv = fp_inv(norm)
-    M = fp_mont_mul(jnp.stack(jnp.broadcast_arrays(a, b)), ninv)
-    return (M[0], fp_neg(M[1]))
+    norm = F.fp_add(F.fp_mont_sqr(a), F.fp_mont_sqr(b))
+    ninv = F.fp_inv(norm)
+    M = F.fp_mont_mul(jnp.stack(jnp.broadcast_arrays(a, b)), ninv)
+    return (M[0], F.fp_neg(M[1]))
 
 
 def f2_stack(elems):
@@ -194,13 +217,15 @@ def _combine_products(prod, lo_m, hi_m):
     zero = jnp.zeros_like(Pre[:1])
     PreE = jnp.concatenate([Pre, zero])
     PimE = jnp.concatenate([Pim, zero])
-    lo_re = fp_sum_stack(PreE[lo_m], axis=1)  # (6, ..., 24)
-    lo_im = fp_sum_stack(PimE[lo_m], axis=1)
-    hi_re = fp_sum_stack(PreE[hi_m], axis=1)
-    hi_im = fp_sum_stack(PimE[hi_m], axis=1)
-    xi_re, xi_im = fp_sub(hi_re, hi_im), fp_add(hi_re, hi_im)
-    out_re = fp_add(lo_re, xi_re)
-    out_im = fp_add(lo_im, xi_im)
+    lo_re = F.fp_sum_stack(PreE[lo_m], axis=1)  # (6, ..., NLIMBS)
+    lo_im = F.fp_sum_stack(PimE[lo_m], axis=1)
+    hi_re = F.fp_sum_stack(PreE[hi_m], axis=1)
+    hi_im = F.fp_sum_stack(PimE[hi_m], axis=1)
+    xi_re, xi_im = F.fp_sub(hi_re, hi_im), F.fp_add(hi_re, hi_im)
+    # products arrive WIDE; one Montgomery reduction per output coefficient
+    # (12 total), batched into a single kernel call
+    out = F.fp_mont_reduce(jnp.stack([F.fp_add(lo_re, xi_re), F.fp_add(lo_im, xi_im)]))
+    out_re, out_im = out[0], out[1]
     return tuple((out_re[k], out_im[k]) for k in range(6))
 
 
@@ -215,7 +240,7 @@ def f12_mul(x, y):
     Y = f2_stack(list(y))
     A = (X[0][_FULL_I], X[1][_FULL_I])
     B = (Y[0][_FULL_J], Y[1][_FULL_J])
-    prod = f2_mul(A, B)  # (36, ..., 24)
+    prod = f2_mul_wide(A, B)  # (36, ..., NLIMBS) wide
     return _combine_products(prod, _FULL_LO, _FULL_HI)
 
 
@@ -234,7 +259,7 @@ def f12_mul_sparse035(f, l0, l3, l5):
     Fs = f2_stack(list(f))
     A = (Fs[0][_SPARSE_I], Fs[1][_SPARSE_I])
     L = f2_stack([l0] * 6 + [l3] * 6 + [l5] * 6)
-    prod = f2_mul(A, L)
+    prod = f2_mul_wide(A, L)
     return _combine_products(prod, _SPARSE_LO, _SPARSE_HI)
 
 
@@ -318,22 +343,21 @@ def _const_f2_stack(gammas):
     # created there would be a DynamicJaxprTracer leaking into later traces
     # (UnexpectedTracerError on the second jitted pairing). numpy constants
     # are trace-safe and embed per-trace.
-    re = np.stack([to_mont(g[0]) for g in gammas])
-    im = np.stack([to_mont(g[1]) for g in gammas])
+    re = np.stack([F.to_mont(g[0]) for g in gammas])
+    im = np.stack([F.to_mont(g[1]) for g in gammas])
     return re, im
 
 
-_G1M_RE, _G1M_IM = None, None
-_G2M_RE, _G2M_IM = None, None
+_GAMMA_CACHE: dict = {}
 
 
 def _gamma_arrays():
-    # deferred so importing this module does not touch a jax backend
-    global _G1M_RE, _G1M_IM, _G2M_RE, _G2M_IM
-    if _G1M_RE is None:
-        _G1M_RE, _G1M_IM = _const_f2_stack(_GAMMA1)
-        _G2M_RE, _G2M_IM = _const_f2_stack(_GAMMA2)
-    return (_G1M_RE, _G1M_IM), (_G2M_RE, _G2M_IM)
+    # deferred so importing this module does not touch a jax backend;
+    # keyed per field backend (the representations differ)
+    key = field_backend()
+    if key not in _GAMMA_CACHE:
+        _GAMMA_CACHE[key] = (_const_f2_stack(_GAMMA1), _const_f2_stack(_GAMMA2))
+    return _GAMMA_CACHE[key]
 
 
 def _gamma_shaped(g, like):
@@ -386,7 +410,7 @@ def _dbl_step(T, xp, yp):
     Z3 = f2_add(YZ, YZ)
     # lines: l0 = 2YZ^3·xi·yp ; l3 = 3X^3 - 2Y^2 ; l5 = -3X^2 Z^2·xp
     xi0 = f2_mul_xi(f2_add(YZcu, YZcu))
-    lm = fp_mont_mul(
+    lm = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(xi0[0], xi0[1], EZsq[0], EZsq[1])),
         jnp.stack(jnp.broadcast_arrays(yp, yp, xp, xp)),
     )
@@ -419,7 +443,7 @@ def _add_step(T, Q, xp, yp):
     Y3 = f2_sub(Y3a, YHcu)
     Z3 = f2_mul(Z, H)
     xiHZ = f2_mul_xi(HZ)
-    lm = fp_mont_mul(
+    lm = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(xiHZ[0], xiHZ[1], r[0], r[1])),
         jnp.stack(jnp.broadcast_arrays(yp, yp, xp, xp)),
     )
@@ -457,12 +481,68 @@ def miller_loop_batch(Qx, Qy, xp, yp):
     return f12_conj(f)  # x < 0
 
 
+def f12_cyclotomic_sqr(f):
+    """Granger-Scott squaring for UNITARY f (the cyclotomic subgroup — i.e.
+    anything after the final exponentiation's easy part): in the
+    Fp4 = Fp2[s]/(s^2 - xi) view with s = w^3, f = A + B·w + C·w^2 and
+
+        f^2 = (3·A² - 2·Ā) + (3·xi·C² + 2·B̄)·w + (3·B² - 2·C̄)·w²
+
+    (bars are the Fp4 conjugation s -> -s). 3 Fp4 squarings ≈ half the
+    products and reductions of a generic f12_sqr; differentially tested
+    against f12_sqr on easy-part outputs."""
+    c0, c1, c2, c3, c4, c5 = f
+    A = (c0, c3)
+    B = (c1, c4)
+    C = (c2, c5)
+
+    def fp4_sqr(x):
+        a, b = x
+        # (a + b·s)^2 = (a^2 + xi·b^2) + (2ab)·s, via 2 squares + 1 product,
+        # all three stacked into one wide multiply
+        X = f2_stack([a, b, a])
+        Y = f2_stack([a, b, b])
+        M = f2_mul_wide(X, Y)
+        a2 = (M[0][0], M[1][0])
+        b2 = (M[0][1], M[1][1])
+        ab = (M[0][2], M[1][2])
+        re = f2_add(a2, f2_mul_xi(b2))
+        im = f2_add(ab, ab)
+        red = f2_reduce(f2_stack([re, im]))
+        return ((red[0][0], red[1][0]), (red[0][1], red[1][1]))
+
+    def triple(x):
+        return f2_add(f2_add(x, x), x)
+
+    def fp4_conj(x):
+        return (x[0], f2_neg(x[1]))
+
+    def mul_s(x):
+        # s·(a + b·s) = xi·b + a·s
+        return (f2_mul_xi(x[1]), x[0])
+
+    A2 = fp4_sqr(A)
+    B2 = fp4_sqr(B)
+    C2 = fp4_sqr(C)
+    cA = fp4_conj(A)
+    cB = fp4_conj(B)
+    cC = fp4_conj(C)
+    sC2 = mul_s(C2)
+    Ao = tuple(f2_sub(triple(t), f2_add(c, c)) for t, c in zip(A2, cA))
+    Bo = tuple(f2_add(triple(t), f2_add(c, c)) for t, c in zip(sC2, cB))
+    Co = tuple(f2_sub(triple(t), f2_add(c, c)) for t, c in zip(B2, cC))
+    return (Ao[0], Bo[0], Co[0], Ao[1], Bo[1], Co[1])
+
+
 def _f12_pow_abs_x(f):
-    """f^|x| by square-and-multiply over the fixed 64-bit loop constant."""
+    """f^|x| by square-and-multiply over the fixed 64-bit loop constant.
+
+    f must be unitary (all final-exp hard-part inputs are): the squaring
+    chain uses the cyclotomic formulas."""
     bits = jnp.asarray(np.array(_X_BITS, dtype=bool))
 
     def body(i, r):
-        r = f12_sqr(r)
+        r = f12_cyclotomic_sqr(r)
         return jax.lax.cond(bits[i], lambda r: f12_mul(r, f), lambda r: r, r)
 
     return jax.lax.fori_loop(0, len(_X_BITS), body, f)
@@ -491,12 +571,13 @@ def final_exponentiation_batch(f):
 
 
 def f12_is_one(f):
-    """(...) bool: f == 1 (Montgomery domain)."""
-    one = f12_one_like(f[0])
-    ok = jnp.ones(f[0][0].shape[:-1], dtype=bool)
-    for c, o in zip(f, one):
-        ok = ok & jnp.all(c[0] == o[0], axis=-1) & jnp.all(c[1] == o[1], axis=-1)
-    return ok
+    """(...) bool: f == 1 (Montgomery domain; representation-aware)."""
+    ok = F.fp_is_one_mont(f[0][0])
+    zero_parts = [f[0][1]]
+    for c in f[1:]:
+        zero_parts.extend([c[0], c[1]])
+    z = F.fp_is_zero(jnp.stack(jnp.broadcast_arrays(*zero_parts)))
+    return ok & jnp.all(z, axis=0)
 
 
 # --- G1 (over Fp) Jacobian ops for aggregation ------------------------------
@@ -504,21 +585,21 @@ def f12_is_one(f):
 
 def g1_double(pt):
     X, Y, Z = pt
-    sq = fp_mont_mul(jnp.stack([X, Y, Z]), jnp.stack([X, Y, Z]))
+    sq = F.fp_mont_mul(jnp.stack([X, Y, Z]), jnp.stack([X, Y, Z]))
     A, B, _ = sq[0], sq[1], sq[2]
-    m1 = fp_mont_mul(jnp.stack([X, Y]), jnp.stack([B, Z]))
+    m1 = F.fp_mont_mul(jnp.stack([X, Y]), jnp.stack([B, Z]))
     D0, YZ = m1[0], m1[1]
-    C = fp_mont_sqr(B)
-    D = fp_add(D0, D0)
-    D = fp_add(D, D)
-    E = fp_add(fp_add(A, A), A)
-    Fv = fp_mont_sqr(E)
-    X3 = fp_sub(Fv, fp_add(D, D))
-    C8 = fp_add(C, C)
-    C8 = fp_add(C8, C8)
-    C8 = fp_add(C8, C8)
-    Y3 = fp_sub(fp_mont_mul(E, fp_sub(D, X3)), C8)
-    Z3 = fp_add(YZ, YZ)
+    C = F.fp_mont_sqr(B)
+    D = F.fp_add(D0, D0)
+    D = F.fp_add(D, D)
+    E = F.fp_add(F.fp_add(A, A), A)
+    Fv = F.fp_mont_sqr(E)
+    X3 = F.fp_sub(Fv, F.fp_add(D, D))
+    C8 = F.fp_add(C, C)
+    C8 = F.fp_add(C8, C8)
+    C8 = F.fp_add(C8, C8)
+    Y3 = F.fp_sub(F.fp_mont_mul(E, F.fp_sub(D, X3)), C8)
+    Z3 = F.fp_add(YZ, YZ)
     return (X3, Y3, Z3)
 
 
@@ -527,37 +608,37 @@ def g1_add(p1, p2):
     (inf inputs, equal points -> double, opposite points -> inf)."""
     X1, Y1, Z1 = p1
     X2, Y2, Z2 = p2
-    inf1 = jnp.all(Z1 == 0, axis=-1)
-    inf2 = jnp.all(Z2 == 0, axis=-1)
-    Z1sq = fp_mont_sqr(Z1)
-    Z2sq = fp_mont_sqr(Z2)
-    m1 = fp_mont_mul(
+    inf1 = F.fp_is_zero(Z1)
+    inf2 = F.fp_is_zero(Z2)
+    Z1sq = F.fp_mont_sqr(Z1)
+    Z2sq = F.fp_mont_sqr(Z2)
+    m1 = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(X1, X2, Z2, Z1)),
         jnp.stack(jnp.broadcast_arrays(Z2sq, Z1sq, Z2sq, Z1sq)),
     )
     U1, U2, Z2cu, Z1cu = m1[0], m1[1], m1[2], m1[3]
-    m2 = fp_mont_mul(
+    m2 = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(Y1, Y2)),
         jnp.stack(jnp.broadcast_arrays(Z2cu, Z1cu)),
     )
     S1, S2 = m2[0], m2[1]
-    H = fp_sub(U2, U1)
-    r = fp_sub(S2, S1)
-    same_x = jnp.all(H == 0, axis=-1)
-    same_y = jnp.all(r == 0, axis=-1)
-    Hsq = fp_mont_sqr(H)
-    m3 = fp_mont_mul(
+    H = F.fp_sub(U2, U1)
+    r = F.fp_sub(S2, S1)
+    same_x = F.fp_is_zero(H)
+    same_y = F.fp_is_zero(r)
+    Hsq = F.fp_mont_sqr(H)
+    m3 = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(H, U1, Z1)),
         jnp.stack(jnp.broadcast_arrays(Hsq, Hsq, Z2)),
     )
     Hcu, V, Z1Z2 = m3[0], m3[1], m3[2]
-    rsq = fp_mont_sqr(r)
-    X3 = fp_sub(fp_sub(rsq, Hcu), fp_add(V, V))
-    m4 = fp_mont_mul(
+    rsq = F.fp_mont_sqr(r)
+    X3 = F.fp_sub(F.fp_sub(rsq, Hcu), F.fp_add(V, V))
+    m4 = F.fp_mont_mul(
         jnp.stack(jnp.broadcast_arrays(r, S1, Z1Z2)),
-        jnp.stack(jnp.broadcast_arrays(fp_sub(V, X3), Hcu, H)),
+        jnp.stack(jnp.broadcast_arrays(F.fp_sub(V, X3), Hcu, H)),
     )
-    Y3 = fp_sub(m4[0], m4[1])
+    Y3 = F.fp_sub(m4[0], m4[1])
     Z3 = m4[2]
     dX, dY, dZ = g1_double(p1)
     is_dbl = same_x & same_y & ~inf1 & ~inf2
@@ -596,16 +677,16 @@ def g1_sum_reduce(pts):
 
 def g1_to_affine(pt):
     X, Y, Z = pt
-    zinv = fp_inv(Z)
-    zinv2 = fp_mont_sqr(zinv)
-    return fp_mont_mul(X, zinv2), fp_mont_mul(Y, fp_mont_mul(zinv, zinv2))
+    zinv = F.fp_inv(Z)
+    zinv2 = F.fp_mont_sqr(zinv)
+    return F.fp_mont_mul(X, zinv2), F.fp_mont_mul(Y, F.fp_mont_mul(zinv, zinv2))
 
 
 # --- host bridging ----------------------------------------------------------
 
 
 def fp_to_device(x: int) -> jnp.ndarray:
-    return jnp.asarray(to_mont(x % P))
+    return jnp.asarray(F.to_mont(x % P))
 
 
 def f2_to_device(x) -> tuple:

@@ -99,3 +99,31 @@ def fp_sum_stack(arr, axis: int = 0) -> jax.Array:
 
 ints_to_mont_batch = FIELD.ints_to_mont_batch
 mont_batch_to_ints = FIELD.mont_batch_to_ints
+
+# --- mod-p equality (canonical representation: direct limb compare) ---------
+
+
+def fp_is_zero(a) -> jax.Array:
+    """(...) bool: a == 0 (elements are canonical, so limb equality)."""
+    return jnp.all(a == 0, axis=-1)
+
+
+def fp_is_one_mont(a) -> jax.Array:
+    """(...) bool: a is the Montgomery-domain 1."""
+    return jnp.all(a == jnp.asarray(ONE_MONT), axis=-1)
+
+
+DTYPE = jnp.uint32
+
+
+# --- lazy-reduction interface parity (no-op in the positional-limb form:
+# fp_mont_mul is already fully reduced, so "wide" == ordinary) --------------
+
+fp_mul_wide = fp_mont_mul
+
+
+def fp_mont_reduce(t):
+    return t
+
+
+SUPPORTS_WIDE = False

@@ -1,0 +1,396 @@
+"""BLS12-381 base-field arithmetic in a Residue Number System — the MXU path.
+
+Why RNS: the positional-limb Montgomery core (ops/limb_mont.py) is inherently
+sequential (per-limb carry/reduction fori_loops over uint64 lanes, which TPUs
+emulate in 32-bit halves); measured ~59 aggregate-verifies/s — ~1,700x off the
+BASELINE.md north star. In an RNS the field element is a vector of small
+residues, multiplication is carry-free and fully lane-parallel int32 work, and
+the one cross-channel step (base extension) is a matrix product against a
+CONSTANT matrix — exactly the op the MXU exists for. This is the
+representation change flagged in limb_mont.py's perf notes.
+
+Representation
+  element: (..., 64) int32 — residues modulo 64 fixed 15-bit primes, the
+  first 32 forming base A (M_A = prod a_i), the last 32 base B (M_B).
+  Montgomery domain with R = M_A: x is stored as residues of x_hat, where
+  x_hat ≡ x·M_A (mod p). All primes sit in (2^15 - 2^10, 2^15 - 128) so that
+  (a) residues split into two int8 halves for MXU matmuls and (b) reduction
+  mod m after an int32 op is a few shift/mul/add folds (2^15 ≡ delta, delta
+  < 2^10).
+
+Redundancy (the contract with the tower code in ops/bls12_jax.py)
+  A value's integer magnitude may exceed p, and may be NEGATIVE — ops only
+  keep per-channel residues reduced, and every channel consistently
+  represents the same (possibly negative) integer, so fp_sub is a plain
+  per-channel subtraction with no normalization. mont_mul tolerates signed
+  inputs (the canonical-q base extension and the wrap-aware second extension
+  both remain exact) and outputs a value in (-p/2^9, 3p). With M_A ≈ 2^479
+  the Montgomery condition |x·y| < M_A·p holds for operand magnitudes up to
+  ~2^49·p, so no realistic add/sub chain between multiplies can overflow and
+  no bound tracking is needed. Equality/zero tests are therefore NOT residue
+  comparisons: fp_is_zero/fp_is_one_mont first "shrink" (Montgomery-multiply
+  by one) into (-p/2^9, 3p), then compare against the residue vectors of
+  {0, p, 2p} / {R, R+p, R+2p} — RNS representations are unique there.
+
+Montgomery multiplication (Bajard/Kawamura, float-assisted base extension)
+  t = x·y per channel; q = -t·p^{-1} in base A; q is extended to base B via
+  sigma_i = q_i·(M_A/a_i)^{-1} mod a_i and the constant matrix
+  C[i][j] = (M_A/a_i) mod b_j, with alpha = floor(sum sigma_i/a_i) estimated
+  in f32 (offset -1/4: may underestimate by 1, never overestimate → q_hat <
+  2·M_A, harmless: it only adds p to the result). r = (t + q_hat·p)/M_A in
+  base B, then extended back to A the same way — that second extension is
+  EXACT because |r| < 3p << M_B parks the fractional sum far from the floor
+  boundary (offset +1/4 >> f32 sum error ~2^-14 >> r/M_B ~ 2^-95). Each
+  extension's inner product runs as four int8 x int8 -> int32 matmuls
+  (balanced-digit split of both factors).
+
+Differentially tested channel-for-channel against Python bigints
+(tests/test_fp_rns.py) and end-to-end through the pairing against the
+crypto/bls12_381.py oracle. Reference framing: the reference's fast backend
+is the milagro C wheel behind utils/bls.py (SURVEY.md §2.2); this module is
+that role, built for the MXU/VPU instead of scalar CPUs.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+K_PER_BASE = 32
+NLIMBS = 2 * K_PER_BASE  # interface name: trailing dim of an element
+LIMB_BITS = 15
+TWO15 = 1 << 15
+
+
+def _gen_primes(lo: int, hi: int, count: int) -> list[int]:
+    """largest `count` primes in (lo, hi), descending."""
+    sieve = np.ones(hi, dtype=bool)
+    sieve[:2] = False
+    for i in range(2, int(hi**0.5) + 1):
+        if sieve[i]:
+            sieve[i * i :: i] = False
+    primes = np.nonzero(sieve)[0]
+    primes = primes[(primes > lo) & (primes < hi)][::-1][:count]
+    assert len(primes) == count, f"only {len(primes)} primes in ({lo}, {hi})"
+    return [int(q) for q in primes]
+
+
+# Keep residues <= 32511 so the balanced int8 split (hi = (v+128)>>8 <= 127)
+# never overflows; keep delta = 2^15 - m < 2^10 so reduction folds converge.
+_PRIMES = _gen_primes(TWO15 - (1 << 10), TWO15 - 128, NLIMBS)
+A_PRIMES = _PRIMES[:K_PER_BASE]
+B_PRIMES = _PRIMES[K_PER_BASE:]
+
+M_A = 1
+for _q in A_PRIMES:
+    M_A *= _q
+M_B = 1
+for _q in B_PRIMES:
+    M_B *= _q
+
+# Montgomery condition headroom: t = x*y < M_A*p for operand bounds c*p
+# requires c^2*p < M_A. SUB_K-sized chains stay far below this.
+_HEADROOM = int((M_A // P) ** 0.5)
+assert _HEADROOM > 2**40, hex(_HEADROOM)
+assert M_B > 1 << 400
+
+_M_ALL = np.asarray(_PRIMES, dtype=np.int32)  # (64,)
+_DELTA = (TWO15 - _M_ALL).astype(np.int32)  # 2^15 mod m
+_MA = np.asarray(A_PRIMES, dtype=np.int32)
+_MB = np.asarray(B_PRIMES, dtype=np.int32)
+
+
+def _residues(x: int, moduli) -> np.ndarray:
+    return np.asarray([x % int(m) for m in moduli], dtype=np.int32)
+
+
+def _split8(mat: np.ndarray, moduli) -> tuple[np.ndarray, np.ndarray]:
+    """int matrix (entries < 2^15) -> balanced int8 (hi, lo): v = hi*256+lo."""
+    hi = (mat + 128) >> 8
+    lo = mat - (hi << 8)
+    assert hi.max() <= 127 and lo.min() >= -128 and lo.max() <= 127
+    return hi.astype(np.int8), lo.astype(np.int8)
+
+
+class _Ext:
+    """Constants for one direction of base extension (src base -> dst base)."""
+
+    def __init__(self, src_primes, dst_primes, m_src_prod):
+        k = len(src_primes)
+        # sigma_i = q_i * (M/m_i)^{-1} mod m_i
+        self.w_inv = np.asarray(
+            [pow(m_src_prod // m, -1, m) for m in src_primes], dtype=np.int32
+        )
+        # C[i][j] = (M/m_i) mod dst_j
+        C = np.asarray(
+            [[(m_src_prod // mi) % mj for mj in dst_primes] for mi in src_primes],
+            dtype=np.int64,
+        )
+        self.C_hi, self.C_lo = _split8(C, dst_primes)
+        self.m_src_prod_mod_dst = _residues(m_src_prod, dst_primes)
+        self.inv_src_f32 = (1.0 / np.asarray(src_primes)).astype(np.float32)
+        self.dst_m = np.asarray(dst_primes, dtype=np.int32)
+        self.dst_delta = (TWO15 - self.dst_m).astype(np.int32)
+
+
+_EXT_AB = _Ext(A_PRIMES, B_PRIMES, M_A)
+_EXT_BA = _Ext(B_PRIMES, A_PRIMES, M_B)
+
+_NEG_PINV_A = np.asarray([(-pow(P, -1, m)) % m for m in A_PRIMES], dtype=np.int32)
+_P_MOD_B = _residues(P, B_PRIMES)
+_MAINV_MOD_B = np.asarray([pow(M_A % m, -1, m) for m in B_PRIMES], dtype=np.int32)
+
+R_MOD_P = M_A % P
+ONE_MONT = _residues(R_MOD_P, _PRIMES)  # to_mont(1)
+ZERO = np.zeros(NLIMBS, dtype=np.int32)
+
+# shrink(x) = mont_mul(x, ONE_MONT) has integer value < 3p; mod-p equality
+# classes below 3p are {v, v+p, v+2p}
+_ZERO_CLASSES = np.stack([_residues(i * P, _PRIMES) for i in range(3)])
+_ONE_CLASSES = np.stack([_residues(R_MOD_P + i * P, _PRIMES) for i in range(3)])
+
+
+# --- host codecs -------------------------------------------------------------
+
+
+def to_mont(x: int) -> np.ndarray:
+    return _residues((x % P) * M_A % P, _PRIMES)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Plain (non-Montgomery) residues; interface parity with fp_jax."""
+    return _residues(x, _PRIMES)
+
+
+def limbs_to_int(limbs) -> int:
+    """CRT reconstruction from the base-A half (exact for values < M_A)."""
+    res = np.asarray(limbs, dtype=np.int64).reshape(-1)[:K_PER_BASE]
+    acc = 0
+    for i, m in enumerate(A_PRIMES):
+        w = M_A // m
+        acc += int(res[i]) * pow(w, -1, m) % m * w
+    return acc % M_A
+
+
+def from_mont_int(limbs) -> int:
+    v = limbs_to_int(limbs)
+    if v > M_A // 2:  # signed representation: interpret the top half as < 0
+        v -= M_A
+    return v * pow(M_A, -1, P) % P
+
+
+def ints_to_mont_batch(xs) -> np.ndarray:
+    xs = list(xs)
+    if not xs:
+        return np.zeros((0, NLIMBS), np.int32)
+    return np.stack([to_mont(int(x)) for x in xs])
+
+
+def mont_batch_to_ints(arr) -> list:
+    a = np.asarray(arr)
+    return [from_mont_int(a[i]) for i in range(a.shape[0])]
+
+
+# --- per-channel reduction ---------------------------------------------------
+
+
+def _fold(x, m, delta):
+    """one step of x mod m via 2^15 = delta: x -> (x>>15)*delta + (x&32767)."""
+    return (x >> LIMB_BITS) * delta + (x & (TWO15 - 1))
+
+
+def _cond_sub(x, m):
+    return jnp.where(x >= m, x - m, x)
+
+
+def _red_full(x, m, delta):
+    """x in [0, 2^31) -> x mod m. 4 folds + 1 conditional subtract."""
+    x = _fold(x, m, delta)
+    x = _fold(x, m, delta)
+    x = _fold(x, m, delta)
+    x = _fold(x, m, delta)
+    return _cond_sub(x, m)
+
+
+def _red_small(x, m, delta):
+    """x in [0, ~2^18) -> x mod m. 2 folds + 1 conditional subtract."""
+    x = _fold(x, m, delta)
+    x = _fold(x, m, delta)
+    return _cond_sub(x, m)
+
+
+def _c(arr):
+    """host constant -> jnp int32 (embedded per-trace; numpy in globals)."""
+    return jnp.asarray(arr, dtype=jnp.int32)
+
+
+# --- field ops (all jitted at the call-site graph level) ---------------------
+
+
+def _add(a, b):
+    m = _c(_M_ALL)
+    return _cond_sub(a + b, m)
+
+
+def _sub(a, b):
+    # represents the signed integer a_int - b_int (every channel consistent)
+    m = _c(_M_ALL)
+    return _cond_sub(a + (m - b), m)
+
+
+def _neg(a):
+    m = _c(_M_ALL)
+    return _cond_sub(m - a, m)  # a == 0 -> m - 0 == m -> 0
+
+
+def _extend(sigma, ext: _Ext, plus_alpha_offset: float):
+    """sum_i sigma_i*(M/m_i) - alpha*M in the destination base.
+
+    sigma: (..., k) int32 residues of the source base. Returns (..., k) int32
+    in [0, ~2^27) == q_hat mod dst_j + (2^11)*dst_j positivity offset, NOT yet
+    reduced (caller folds it into its next reduction)."""
+    m = _c(ext.dst_m)
+    delta = _c(ext.dst_delta)
+    hi = (sigma + 128) >> 8
+    lo = sigma - (hi << 8)
+    dot = partial(jax.lax.dot_general, dimension_numbers=(((sigma.ndim - 1,), (0,)), ((), ())),
+                  preferred_element_type=jnp.int32)
+    hh = dot(hi.astype(jnp.int8), _c(ext.C_hi).astype(jnp.int8))
+    hl = dot(hi.astype(jnp.int8), _c(ext.C_lo).astype(jnp.int8))
+    lh = dot(lo.astype(jnp.int8), _c(ext.C_hi).astype(jnp.int8))
+    ll = dot(lo.astype(jnp.int8), _c(ext.C_lo).astype(jnp.int8))
+    # recombine mod m: v = hh*2^16 + (hl+lh)*2^8 + ll, term-wise reduced.
+    # |hl+lh| <= 2*32*127*128 < 2^21; +64m (> 2^21) keeps terms nonnegative.
+    off64 = m << 6
+    s_hh = _red_small(hh, m, delta)  # hi, C_hi >= 0: already nonnegative
+    s_mid = _red_small(hl + lh + off64, m, delta)
+    s_ll = _red_small(ll + off64, m, delta)
+    two16 = _c(2 * ext.dst_delta)  # 2^16 mod m (delta < 2^10 so 2delta < m)
+    v = _red_full(s_hh * two16, m, delta) + (s_mid << 8) + s_ll  # < m + 2^23 + m
+    # alpha estimate (Kawamura): fractional sums in f32
+    frac = jnp.sum(sigma.astype(jnp.float32) * _c_f32(ext.inv_src_f32), axis=-1)
+    alpha = jnp.floor(frac + plus_alpha_offset).astype(jnp.int32)
+    v = v + (m << 11) - alpha[..., None] * _c(ext.m_src_prod_mod_dst)
+    return _red_full(v, m, delta)
+
+
+def _c_f32(arr):
+    return jnp.asarray(arr, dtype=jnp.float32)
+
+
+def _mul_wide(x, y):
+    """Per-channel product, channel-reduced but NOT Montgomery-reduced: the
+    result represents the integer x_int*y_int (double Montgomery scale).
+    Wide values add/sub/sum with the ordinary ops; _mont_reduce brings them
+    back to single scale. This is the tower's lazy-reduction primitive: an
+    Fp12 multiply accumulates its products wide and pays one reduction per
+    output coefficient instead of one per product."""
+    return _red_full(x * y, _c(_M_ALL), _c(_DELTA))
+
+
+def _mont_reduce(t):
+    """t -> t*M_A^{-1} (mod p), |result| < 3p; t any channel-reduced value."""
+    tA = t[..., :K_PER_BASE]
+    tB = t[..., K_PER_BASE:]
+    mA = _c(_MA)
+    dA = _c(_DELTA[:K_PER_BASE])
+    mB = _c(_MB)
+    dB = _c(_DELTA[K_PER_BASE:])
+    q = _red_full(tA * _c(_NEG_PINV_A), mA, dA)
+    sigma = _red_full(q * _c(_EXT_AB.w_inv), mA, dA)
+    # alpha may underestimate by 1 (offset -1/4): q_hat in [0, 2*M_A)
+    q_hat = _extend(sigma, _EXT_AB, -0.25)
+    u = _red_full(tB + _red_full(q_hat * _c(_P_MOD_B), mB, dB), mB, dB)
+    rB = _red_full(u * _c(_MAINV_MOD_B), mB, dB)
+    # exact extension back: |r| < 3p << M_B so floor(frac + 1/4) is alpha
+    sigma2 = _red_full(rB * _c(_EXT_BA.w_inv), mB, dB)
+    rA = _extend(sigma2, _EXT_BA, 0.25)
+    return jnp.concatenate([rA, rB], axis=-1)
+
+
+def _mont_mul(x, y):
+    """x*y*M_A^{-1} (mod p); (..., 64) reduced residues; output in (-p/2^9, 3p)."""
+    return _mont_reduce(_mul_wide(x, y))
+
+
+def _pow_const(a, exponent: int):
+    bits = jnp.asarray(np.array([int(c) for c in bin(exponent)[2:]], dtype=np.int32))
+    one = jnp.broadcast_to(_c(ONE_MONT), a.shape)
+
+    def body(i, acc):
+        acc = _mont_mul(acc, acc)
+        mul = _mont_mul(acc, a)
+        return jnp.where(bits[i] == 1, mul, acc)
+
+    return jax.lax.fori_loop(0, bits.shape[0], body, one)
+
+
+fp_add = jax.jit(_add)
+fp_sub = jax.jit(_sub)
+fp_neg = jax.jit(_neg)
+fp_mont_mul = jax.jit(_mont_mul)
+fp_mont_sqr = jax.jit(lambda a: _mont_mul(a, a))
+fp_mul_wide = jax.jit(_mul_wide)
+fp_mont_reduce = jax.jit(_mont_reduce)
+fp_pow_const = partial(jax.jit, static_argnums=(1,))(_pow_const)
+SUPPORTS_WIDE = True
+
+
+def fp_inv(a):
+    """Batched Fermat inversion a^(p-2); zero maps to zero."""
+    return fp_pow_const(a, P - 2)
+
+
+def fp_sum_stack(arr, axis: int = 0):
+    """Sum <= 8 reduced (..., 64) residue vectors along `axis`."""
+    assert arr.shape[axis] <= 8
+    m = _c(_M_ALL)
+    # dtype pinned: jnp reductions promote int32 -> int64 under x64
+    return _red_small(arr.sum(axis=axis, dtype=jnp.int32), m, _c(_DELTA))
+
+
+def fp_sqrt_candidate(a):
+    """a^((p+1)/4) — square root when a is a QR (p ≡ 3 mod 4)."""
+    return fp_pow_const(a, (P + 1) // 4)
+
+
+# --- mod-p equality (shrink + class compare) --------------------------------
+
+
+def _shrink(a):
+    """same class mod p, integer value in (-p/2^9, 3p)."""
+    return _mont_mul(a, jnp.broadcast_to(_c(ONE_MONT), a.shape))
+
+
+def _in_classes(small, classes):
+    """small: (..., 64) residues of a value < 3p; classes: (3, 64) host."""
+    cls = _c(classes)
+    eq = jnp.all(small[..., None, :] == cls, axis=-1)  # (..., 3)
+    return jnp.any(eq, axis=-1)
+
+
+def fp_is_zero(a):
+    """(...) bool: a ≡ 0 (mod p). Accepts any reduced-residue element."""
+    return _in_classes(_shrink(a), _ZERO_CLASSES)
+
+
+def fp_is_one_mont(a):
+    """(...) bool: a is the Montgomery-domain 1 (i.e. value ≡ R mod p)."""
+    return _in_classes(_shrink(a), _ONE_CLASSES)
+
+
+# --- import-time self-check (host-side, no jax backend touched) -------------
+
+assert from_mont_int(to_mont(12345)) == 12345
+assert from_mont_int(ONE_MONT) == 1
+_xchk = 0xDEADBEEF_CAFEBABE_0123456789ABCDEF % P
+assert limbs_to_int(int_to_limbs(_xchk)) == _xchk
+
+
+DTYPE = jnp.int32

@@ -17,8 +17,17 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
 
 from consensus_specs_tpu.crypto import bls_sig
 from consensus_specs_tpu.crypto.bls12_381 import R as CURVE_ORDER
+from consensus_specs_tpu.crypto.hash_to_curve import MAP_TO_CURVE_RFC_COMPLIANT
 from consensus_specs_tpu.gen import TestCase, TestProvider
 from consensus_specs_tpu.gen.gen_runner import run_generator
+
+# Interop gate (VERDICT r1): vectors produced with a non-RFC-9380 map would
+# look valid but be unusable by real clients — refuse to emit them silently.
+if not MAP_TO_CURVE_RFC_COMPLIANT:  # not assert: must survive python -O
+    raise SystemExit(
+        "hash-to-curve is not RFC-9380 interoperable; BLS vectors would not "
+        "be client-consumable (see crypto/hash_to_curve.py)"
+    )
 
 PRIVKEYS = [
     1,

@@ -211,3 +211,41 @@ def test_bls_off_switch():
         assert bls.Sign(1, b"x") == bls.STUB_SIGNATURE
     finally:
         bls.bls_active = True
+
+
+# --- RFC 9380 interoperability (VERDICT r1 item #3) -------------------------
+
+def test_hash_to_curve_rfc9380_vector():
+    """BLS12381G2_XMD:SHA-256_SSWU_RO_ suite vector (RFC 9380 J.10.1,
+    msg=""): full affine output of hash_to_curve with the RFC test DST.
+    This pins the SSWU + derived 3-isogeny + clear_cofactor pipeline to the
+    published suite bit-for-bit."""
+    from consensus_specs_tpu.crypto.hash_to_curve import (
+        MAP_TO_CURVE_RFC_COMPLIANT,
+        hash_to_curve_g2,
+    )
+
+    assert MAP_TO_CURVE_RFC_COMPLIANT is True
+    dst = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    pt = hash_to_curve_g2(b"", dst)
+    assert pt[0] == (
+        0x0141EBFBDCA40EB85B87142E130AB689C673CF60F1A3E98D69335266F30D9B8D4AC44C1038E9DCDD5393FAF5C41FB78A,
+        0x05CB8437535E20ECFFAEF7752BADDF98034139C38452458BAEEFAB379BA13DFF5BF5DD71B72418717047F5B0F37DA03D,
+    )
+    assert pt[1] == (
+        0x0503921D7F6A12805E72940B963C0CF3471C7B2A524950CA195D11062EE75EC076DAF2D4BC358C4B190C0C98064FDD92,
+        0x12424AC32561493F3FE3C260708A12B7C620E7BE00099A974E259DDC7D1F6395C3C811CDD19F1E8DBF3E9ECFDCBAB8D6,
+    )
+
+
+def test_expand_message_xmd_structure():
+    """expand_message_xmd self-consistency: deterministic, length-exact,
+    DST-separated (full RFC vectors for the expansion live in the J.10.1
+    check above, which exercises it end-to-end)."""
+    from consensus_specs_tpu.crypto.hash_to_curve import expand_message_xmd
+
+    a = expand_message_xmd(b"msg", b"DST-A", 96)
+    b = expand_message_xmd(b"msg", b"DST-B", 96)
+    assert len(a) == len(b) == 96
+    assert a != b
+    assert expand_message_xmd(b"msg", b"DST-A", 96) == a

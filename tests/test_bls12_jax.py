@@ -8,13 +8,21 @@ import pytest
 
 from consensus_specs_tpu.crypto import bls12_381 as oracle
 from consensus_specs_tpu.ops import bls12_jax as K
-from consensus_specs_tpu.ops import fp_jax as F
 
 rng = random.Random(99)
 
 
+@pytest.fixture(params=["rns", "limb"])
+def backend(request):
+    """Run the tower differentials on BOTH field backends (the RNS/MXU path
+    and the positional-limb path); pairing tests pin one per test below."""
+    K.set_field_backend(request.param)
+    yield request.param
+    K.set_field_backend("rns")
+
+
 def rand_f2():
-    return (rng.randrange(F.P), rng.randrange(F.P))
+    return (rng.randrange(K.P), rng.randrange(K.P))
 
 
 def f2_dev(x):
@@ -23,8 +31,8 @@ def f2_dev(x):
 
 def f2_host(x):
     return (
-        F.from_mont_int(np.asarray(x[0]).reshape(-1, F.NLIMBS)[0]),
-        F.from_mont_int(np.asarray(x[1]).reshape(-1, F.NLIMBS)[0]),
+        K.F.from_mont_int(np.asarray(x[0]).reshape(-1, K.F.NLIMBS)[0]),
+        K.F.from_mont_int(np.asarray(x[1]).reshape(-1, K.F.NLIMBS)[0]),
     )
 
 
@@ -32,7 +40,7 @@ F2_SAMPLES = [rand_f2() for _ in range(6)] + [(0, 0), (1, 0), (0, 1)]
 
 
 @pytest.mark.parametrize("op", ["add", "sub", "mul", "sqr", "inv", "xi"])
-def test_f2_ops(op):
+def test_f2_ops(op, backend):
     for a in F2_SAMPLES:
         b = rand_f2()
         da, db = f2_dev(a), f2_dev(b)
@@ -64,7 +72,7 @@ def f12_dev(x):
 F12_SAMPLES = [rand_f12() for _ in range(3)]
 
 
-def test_f12_mul_sqr_inv_conj():
+def test_f12_mul_sqr_inv_conj(backend):
     for a in F12_SAMPLES:
         b = rand_f12()
         da, db = f12_dev(a), f12_dev(b)
@@ -74,7 +82,7 @@ def test_f12_mul_sqr_inv_conj():
         assert K.f12_from_device(K.f12_inv(da)) == oracle.f12_inv(a)
 
 
-def test_f12_frobenius():
+def test_f12_frobenius(backend):
     for a in F12_SAMPLES:
         da = f12_dev(a)
         assert K.f12_from_device(K.f12_frobenius(da)) == oracle.f12_frobenius(a, 1)
@@ -107,7 +115,7 @@ def test_pairing_check_bilinear():
     g1 = oracle.G1_GEN_AFF
     _, qa = _pairing_inputs(1, a)
     g2 = oracle.G2_GEN_AFF
-    neg_g1 = (g1[0], (-g1[1]) % F.P)
+    neg_g1 = (g1[0], (-g1[1]) % K.P)
 
     def dev_f2pair(q):
         x, y = K.f2_to_device(q[0]), K.f2_to_device(q[1])
@@ -129,7 +137,7 @@ def test_pairing_check_bilinear():
     assert not bool(bad)
 
 
-def test_g1_add_reduce():
+def test_g1_add_reduce(backend):
     pts = [
         oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, k))
         for k in (1, 2, 3, 10)
@@ -137,11 +145,58 @@ def test_g1_add_reduce():
     want = oracle.pt_to_affine(oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, 16))
     X = jnp.stack([K.fp_to_device(p[0]) for p in pts])
     Y = jnp.stack([K.fp_to_device(p[1]) for p in pts])
-    Z = jnp.stack([jnp.asarray(F.ONE_MONT)] * len(pts))
+    Z = jnp.stack([jnp.asarray(K.F.ONE_MONT)] * len(pts))
     s = K.g1_sum_reduce((X, Y, Z))
     ax, ay = K.g1_to_affine(s)
     got = (
-        F.from_mont_int(np.asarray(ax)),
-        F.from_mont_int(np.asarray(ay)),
+        K.F.from_mont_int(np.asarray(ax)),
+        K.F.from_mont_int(np.asarray(ay)),
     )
+    assert got == want
+
+
+def test_pairing_check_limb_backend_pairing():
+    """End-to-end pairing on the positional-limb backend (the CPU-oriented
+    path): e([a]G1, G2)·e(-G1, [a]G2) == 1 and a corrupted pair fails. Keeps
+    the still-supported limb field covered through the full Miller/final-exp
+    stack after the RNS backend became the default."""
+    K.set_field_backend("limb")
+    try:
+        a = 9
+        pa, _ = _pairing_inputs(a, 1)
+        _, qa = _pairing_inputs(1, a)
+        g1 = oracle.G1_GEN_AFF
+        g2 = oracle.G2_GEN_AFF
+        neg_g1 = (g1[0], (-g1[1]) % K.P)
+
+        def dev_f2pair(q):
+            x, y = K.f2_to_device(q[0]), K.f2_to_device(q[1])
+            return (x[0], x[1]), (y[0], y[1])
+
+        qx1, qy1 = dev_f2pair(g2)
+        qx2, qy2 = dev_f2pair(qa)
+        ok = K.pairing_check_batch(
+            qx1, qy1, K.fp_to_device(pa[0]), K.fp_to_device(pa[1]),
+            qx2, qy2, K.fp_to_device(neg_g1[0]), K.fp_to_device(neg_g1[1]),
+        )
+        assert bool(ok)
+        bad = K.pairing_check_batch(
+            qx1, qy1, K.fp_to_device(pa[0]), K.fp_to_device(pa[1]),
+            qx2, qy2, K.fp_to_device(g1[0]), K.fp_to_device(g1[1]),
+        )
+        assert not bool(bad)
+    finally:
+        K.set_field_backend("rns")
+
+
+def test_cyclotomic_sqr_matches_generic_pairing():
+    """f12_cyclotomic_sqr == f12_mul(f, f) on a unitary element (a reduced
+    pairing value is in G_T, hence unitary) — the differential check the
+    final-exp x-power chains rely on."""
+    p1, q1 = _pairing_inputs(3, 4)
+    qx, qy = K.f2_to_device(q1[0]), K.f2_to_device(q1[1])
+    px, py = K.fp_to_device(p1[0]), K.fp_to_device(p1[1])
+    f = K.pairing_cube_batch((qx[0], qx[1]), (qy[0], qy[1]), px, py)
+    got = K.f12_from_device(K.f12_cyclotomic_sqr(f))
+    want = K.f12_from_device(K.f12_sqr(f))
     assert got == want

@@ -1,0 +1,146 @@
+"""Differential tests: RNS field kernels (ops/fp_rns.py) vs Python bigints.
+
+The RNS backend's contract is subtle (signed redundant values, approximate
+first base extension, exact second extension), so every op is checked against
+exact integer arithmetic mod p, including long mixed op chains that mimic the
+pairing tower's usage pattern.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.ops import fp_rns as R
+
+P = R.P
+rng = np.random.default_rng(42)
+
+
+def rand_ints(n):
+    return [int.from_bytes(rng.bytes(48), "little") % P for _ in range(n)]
+
+
+def to_dev(xs):
+    return np.stack([R.to_mont(x) for x in xs])
+
+
+def from_dev(arr):
+    return [int(v) % P for v in R.mont_batch_to_ints(np.asarray(arr))]
+
+
+def test_codec_roundtrip():
+    xs = rand_ints(16) + [0, 1, P - 1]
+    assert from_dev(to_dev(xs)) == xs
+
+
+def test_mont_mul_batch():
+    xs, ys = rand_ints(64), rand_ints(64)
+    out = R.fp_mont_mul(to_dev(xs), to_dev(ys))
+    want = [x * y % P for x, y in zip(xs, ys)]
+    assert from_dev(out) == want
+
+
+def test_mont_mul_edge_zero_one():
+    xs = [0, 1, P - 1, 0]
+    ys = [123, 0, P - 1, 0]
+    out = R.fp_mont_mul(to_dev(xs), to_dev(ys))
+    assert from_dev(out) == [x * y % P for x, y in zip(xs, ys)]
+
+
+def test_add_sub_neg_signed_semantics():
+    xs, ys = rand_ints(32), rand_ints(32)
+    a, b = to_dev(xs), to_dev(ys)
+    assert from_dev(R.fp_add(a, b)) == [(x + y) % P for x, y in zip(xs, ys)]
+    # sub results represent signed integers; reduce mod p at readout
+    assert from_dev(R.fp_sub(a, b)) == [(x - y) % P for x, y in zip(xs, ys)]
+    assert from_dev(R.fp_neg(a)) == [(-x) % P for x in xs]
+
+
+def test_deep_mixed_chain_vs_bigint():
+    """Mimic tower usage: adds/subs/negs stacked between mont muls, with
+    magnitudes growing well past p in both directions."""
+    xs = rand_ints(8)
+    dev = [to_dev([x]) for x in xs]
+    ref = list(xs)
+
+    # v = ((x0 - x1) + (x2 - x3)*2 - x4*3) etc., then multiplied pairwise
+    d_acc = R.fp_sub(dev[0], dev[1])
+    r_acc = xs[0] - xs[1]
+    for i in range(2, 8):
+        t = R.fp_sub(dev[i], dev[(i + 3) % 8])
+        d_acc = R.fp_add(d_acc, t)
+        r_acc = r_acc + (xs[i] - xs[(i + 3) % 8])
+        if i % 3 == 0:
+            d_acc = R.fp_neg(d_acc)
+            r_acc = -r_acc
+    prod = R.fp_mont_mul(d_acc, d_acc)
+    want = (r_acc * r_acc) % P
+    assert from_dev(prod)[0] == want
+    # multiply the (possibly negative, >p magnitude) accumulator by a fresh
+    # operand without shrinking first
+    prod2 = R.fp_mont_mul(d_acc, dev[5])
+    assert from_dev(prod2)[0] == (r_acc * xs[5]) % P
+
+
+def test_sum_stack():
+    xs = [rand_ints(8) for _ in range(5)]
+    arr = np.stack([to_dev(row) for row in xs])  # (5, 8, 64)
+    out = R.fp_sum_stack(arr, axis=0)
+    want = [(sum(col) % P) for col in zip(*xs)]
+    assert from_dev(out) == want
+
+
+def test_pow_const_and_inv():
+    xs = rand_ints(4)
+    a = to_dev(xs)
+    out = R.fp_pow_const(a, 65537)
+    assert from_dev(out) == [pow(x, 65537, P) for x in xs]
+    inv = R.fp_inv(a)
+    assert from_dev(inv) == [pow(x, P - 2, P) for x in xs]
+
+
+def test_is_zero_and_is_one():
+    xs = [0, 1, P - 1, 5]
+    a = to_dev(xs)
+    assert list(np.asarray(R.fp_is_zero(a))) == [True, False, False, False]
+    assert list(np.asarray(R.fp_is_one_mont(a))) == [False, True, False, False]
+    # a value that is ≡ 0 mod p only after un-normalized arithmetic:
+    # (x - x) and (x + (p - x)) both hold signed/over-p representations
+    b = R.fp_sub(a, a)
+    assert list(np.asarray(R.fp_is_zero(b))) == [True] * 4
+    c = R.fp_add(a, to_dev([(P - x) % P for x in xs]))
+    assert list(np.asarray(R.fp_is_zero(c))) == [True] * 4
+    # one reached through arithmetic (not the literal ONE_MONT pattern)
+    xinv = R.fp_inv(to_dev(rand_ints(4)))
+    d = R.fp_mont_mul(R.fp_inv(xinv), xinv)
+    assert list(np.asarray(R.fp_is_one_mont(d))) == [True] * 4
+
+
+def test_sqrt_candidate():
+    xs = [x * x % P for x in rand_ints(6)]
+    out = R.fp_sqrt_candidate(to_dev(xs))
+    got = from_dev(out)
+    for x, s in zip(xs, got):
+        assert s * s % P == x
+
+
+def test_randomized_op_fuzz():
+    """Random op sequences on a small working set, checked every step."""
+    local = np.random.default_rng(7)
+    vals = rand_ints(4)
+    devs = to_dev(vals)  # (4, 64)
+    refs = list(vals)
+    for step in range(60):
+        op = local.integers(0, 4)
+        i, j = local.integers(0, 4, 2)
+        if op == 0:
+            devs[i] = R.fp_add(devs[i], devs[j])
+            refs[i] = refs[i] + refs[j]
+        elif op == 1:
+            devs[i] = R.fp_sub(devs[i], devs[j])
+            refs[i] = refs[i] - refs[j]
+        elif op == 2:
+            devs[i] = R.fp_mont_mul(devs[i], devs[j])
+            refs[i] = refs[i] * refs[j] % P
+        else:
+            devs[i] = R.fp_neg(devs[i])
+            refs[i] = -refs[i]
+        assert from_dev(devs)[i] == refs[i] % P, f"divergence at step {step} op {op}"
