@@ -606,6 +606,42 @@ class _Sequence(SSZType):
     def __contains__(self, v):
         return v in self._elems
 
+    # --- bulk columnar paths (engine bridge / registry-scale IO) ------------
+
+    def to_numpy(self):
+        """uint/boolean sequence -> numpy array in one C-driven pass (uints
+        subclass int, so np.fromiter reads them without per-element Python).
+        The registry-scale bridge (engine/bridge.py) depends on this being
+        O(n) C work, not O(n) interpreter work."""
+        import numpy as np
+
+        et = self.ELEM_TYPE
+        _dtypes = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+        if issubclass(et, boolean):
+            dtype = np.bool_
+        elif issubclass(et, uint) and et.type_byte_length() in _dtypes:
+            dtype = _dtypes[et.type_byte_length()]
+        else:
+            raise TypeError(f"to_numpy: {et.__name__} has no numpy dtype")
+        return np.fromiter(self._elems, dtype=dtype, count=len(self._elems))
+
+    @classmethod
+    def from_values(cls, values):
+        """Bulk-construct from raw ints/bools: one boxing pass, no per-element
+        coerce dispatch. `values` may be any iterable of in-range values
+        (numpy arrays: pass arr.tolist() — iterating numpy scalars is slow)."""
+        et = cls.ELEM_TYPE
+        if issubclass(et, uint) and not issubclass(et, boolean):
+            # preserve coerce()'s bool rejection (bool subclasses int): a
+            # numpy bool column fed into a uint list must fail loudly
+            values = list(values)
+            if any(type(v) is bool for v in values):
+                raise TypeError(f"cannot build {cls.__name__} from bools")
+        out = cls.__new__(cls)
+        out._elems = [et(v) for v in values]
+        out._check_length(len(out._elems))
+        return out
+
     # --- shared serialization over self._elems ---
 
     def encode_bytes(self) -> bytes:

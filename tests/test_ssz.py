@@ -363,3 +363,41 @@ def test_decode_offset_bomb_rejected():
 def test_union_none_only_first():
     with pytest.raises(TypeError):
         Union[uint64, None]
+
+
+def test_sequence_bulk_numpy_roundtrip():
+    """to_numpy/from_values — the registry-scale bridge's columnar IO."""
+    import numpy as np
+
+    L = List[uint64, 1024]
+    xs = [0, 1, 2**64 - 1, 7, 42]
+    lst = L.from_values(xs)
+    assert isinstance(lst, L) and list(lst) == xs
+    arr = lst.to_numpy()
+    assert arr.dtype == np.uint64 and arr.tolist() == xs
+    assert serialize(lst) == serialize(L(xs))
+    assert hash_tree_root(lst) == hash_tree_root(L(xs))
+
+    # empty list
+    empty = L.from_values([])
+    assert len(empty) == 0 and empty.to_numpy().shape == (0,)
+
+    # limit / length enforcement survives the fast path
+    with pytest.raises(ValueError):
+        List[uint64, 2].from_values([1, 2, 3])
+    with pytest.raises(ValueError):
+        Vector[uint8, 4].from_values([1, 2, 3])
+    # ...and so does coerce()'s bool rejection for uint sequences
+    with pytest.raises(TypeError):
+        List[uint64, 8].from_values([True, False])
+    with pytest.raises(TypeError):
+        List[uint256, 4]([1]).to_numpy()
+
+    # vectors and bools
+    v = Vector[boolean, 4].from_values([True, False, True, True])
+    assert v.to_numpy().dtype == np.bool_
+    assert serialize(v) == serialize(Vector[boolean, 4]([True, False, True, True]))
+
+    # uint8 participation-flag shape
+    part = List[uint8, 64].from_values([0, 1, 3, 7])
+    assert part.to_numpy().dtype == np.uint8
