@@ -35,7 +35,11 @@ pyspec:
 	    [get_spec(f, p) for f in ('phase0','altair','bellatrix') for p in ('minimal','mainnet')]; \
 	    print('all fork x preset spec modules compile')"
 
+# Static gate: compile-check + AST lint (unused imports, import shadowing,
+# mutable defaults, tuple asserts, bare excepts). The reference's
+# flake8+mypy role (linter.ini) — those tools are not in this image.
 lint: pyspec
+	$(PYTHON) tools/lint.py
 
 # Regenerate the checked-in randomized test module (reference:
 # tests/generators/random/generate.py workflow).

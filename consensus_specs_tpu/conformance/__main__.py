@@ -5,7 +5,6 @@ Replays a consensus-spec-tests-layout vector tree against the compiled
 specs and reports pass/fail/skip counts (non-zero exit on failures).
 """
 import argparse
-import sys
 
 from .runner import replay_tree
 

@@ -104,6 +104,10 @@ def _replay_operations(spec, case_dir, meta):
     }
     if hasattr(spec, "SyncAggregate"):
         table["sync_aggregate"] = (spec.SyncAggregate, spec.process_sync_aggregate)
+    # body-shaped operations (beyond the reference's format surface: the
+    # reference keeps randao/eth1_data as unittests; here they are vectors)
+    table["randao"] = (spec.BeaconBlockBody, spec.process_randao)
+    table["eth1_data"] = (spec.BeaconBlockBody, spec.process_eth1_data)
     if hasattr(spec, "ExecutionPayload"):
         table["execution_payload"] = (
             spec.ExecutionPayload,
@@ -292,6 +296,11 @@ def _replay_fork_choice(spec, case_dir, meta):
             block = _read_ssz(case_dir, step["block"], spec.SignedBeaconBlock)
             if step.get("valid", True):
                 spec.on_block(store, block)
+                # block attestations reach the fork choice too (reference
+                # helpers/fork_choice.py:143 semantics, mirrored by
+                # testlib/fork_choice.add_block_step)
+                for attestation in block.message.body.attestations:
+                    spec.on_attestation(store, attestation, is_from_block=True)
             else:
                 try:
                     spec.on_block(store, block)

@@ -21,8 +21,8 @@ from __future__ import annotations
 import hashlib
 
 from .bls12_381 import (
-    B_G2, F2_ONE, F2_ZERO, FP2_FIELD, P, R, X_PARAM, f2_add, f2_conj, f2_inv,
-    f2_mul, f2_neg, f2_pow, f2_sqr, f2_sqrt, f2_sub, g2_on_curve, pt_add,
+    B_G2, F2_ONE, F2_ZERO, FP2_FIELD, P, X_PARAM, f2_add, f2_conj, f2_inv,
+    f2_mul, f2_neg, f2_pow, f2_sqr, f2_sqrt, g2_on_curve, pt_add,
     pt_from_affine, pt_mul, pt_neg, pt_to_affine,
 )
 

@@ -28,7 +28,7 @@ every mutated field against the compiled spec on randomized states.
 """
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp

@@ -30,7 +30,6 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-import jax.numpy as jnp
 from flax import struct
 
 

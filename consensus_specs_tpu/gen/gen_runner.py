@@ -43,7 +43,7 @@ def _dump_ssz(path: Path, name: str, value) -> None:
 def _write_case(case: TestCase, case_dir: Path, log: list[str]) -> bool:
     """Returns True if the case produced output (False => skipped/empty)."""
     parts = case.case_fn()
-    if parts is None:
+    if not parts:  # None or [] — a body that declined (preset guard etc.)
         return False
     case_dir.mkdir(parents=True, exist_ok=True)
     incomplete = case_dir / "INCOMPLETE"

@@ -7,7 +7,6 @@ processing), rebuilt against this framework's executable custody overlay
 (specs/custody_game/beacon-chain.md) via the testlib/custody.py scenario
 builders.
 """
-from ..crypto import bls
 from ..ssz import hash_tree_root
 from ..testlib.attestations import get_valid_attestation, sign_attestation
 from ..testlib.context import (

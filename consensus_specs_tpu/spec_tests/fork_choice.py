@@ -106,3 +106,166 @@ def test_proposer_boost_is_set_and_reset(spec, state):
     assert store.proposer_boost_root == spec.Root()
     add_checks_step(spec, store, steps)
     yield from finalize_steps(parts, steps)
+
+
+# --- on_block edge cases (reference parity: fork_choice/test_on_block.py) ---
+
+from ..testlib.attestations import next_epoch_with_attestations  # noqa: E402
+from ..testlib.block import build_empty_block_for_next_slot  # noqa: E402
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_slot_rejected(spec, state):
+    """A block whose slot is ahead of the store's clock must be rejected
+    until time catches up."""
+    store, parts, steps = initialize_steps(spec, state)
+    tmp = state.copy()
+    block = build_empty_block(spec, tmp, spec.Slot(2))
+    signed = state_transition_and_sign_block(spec, tmp, block)
+    # store time still at genesis slot: block from the future
+    add_block_step(spec, store, parts, steps, signed, valid=False)
+    tick_to_slot_step(spec, store, steps, 2)
+    add_block_step(spec, store, parts, steps, signed)
+    head = add_checks_step(spec, store, steps)
+    assert store.blocks[head].slot == 2
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_unknown_parent_rejected(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    tmp = state.copy()
+    b1 = build_empty_block(spec, tmp, spec.Slot(1))
+    state_transition_and_sign_block(spec, tmp, b1)
+    b2 = build_empty_block_for_next_slot(spec, tmp)
+    signed2 = state_transition_and_sign_block(spec, tmp, b2)
+    tick_to_slot_step(spec, store, steps, 2)
+    # deliver only the child: parent unknown -> rejected
+    add_block_step(spec, store, parts, steps, signed2, valid=False)
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_before_finalized_slot_rejected(spec, state):
+    """Once finality advances, a (would-be) fork branching before the
+    finalized slot is pruned/rejected."""
+    store, parts, steps = initialize_steps(spec, state)
+    # a stale competing block at slot 1, built but delivered much later
+    stale_state = state.copy()
+    stale = build_empty_block(spec, stale_state, spec.Slot(1))
+    stale.body.graffiti = spec.Bytes32(b"\x55" * 32)
+    stale_signed = state_transition_and_sign_block(spec, stale_state, stale)
+
+    # drive finality with 4 fully-attested epochs
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        for signed in blocks:
+            tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+            add_block_step(spec, store, parts, steps, signed)
+    assert int(store.finalized_checkpoint.epoch) > 0
+    add_block_step(spec, store, parts, steps, stale_signed, valid=False)
+    head = add_checks_step(spec, store, steps)
+    assert int(store.blocks[head].slot) == int(state.slot)
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_finality_advances_store(spec, state):
+    """Store checkpoints track the chain's justification/finalization."""
+    store, parts, steps = initialize_steps(spec, state)
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        for signed in blocks:
+            tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+            add_block_step(spec, store, parts, steps, signed)
+    add_checks_step(spec, store, steps)
+    assert int(store.justified_checkpoint.epoch) >= 2
+    assert int(store.finalized_checkpoint.epoch) >= 1
+    assert store.finalized_checkpoint == state.finalized_checkpoint
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_applied_and_reset(spec, state):
+    """A timely block gets the proposer-boost weight; the boost resets on the
+    next tick."""
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 1)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    add_block_step(spec, store, parts, steps, signed)
+    root = signed.message.hash_tree_root()
+    assert store.proposer_boost_root == root
+    tick_to_slot_step(spec, store, steps, 2)
+    assert store.proposer_boost_root == spec.Root()
+    head = add_checks_step(spec, store, steps)
+    assert head == root
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_outweighs_attestation(spec, state):
+    """A lone attestation for branch A loses to a timely boosted block B
+    within the same slot window (PROPOSER_SCORE_BOOST=70%% of committee
+    weight on minimal outweighs one attester)."""
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 1)
+
+    state_a = state.copy()
+    block_a = build_empty_block(spec, state_a, spec.Slot(1))
+    block_a.body.graffiti = spec.Bytes32(b"\x0a" * 32)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    add_block_step(spec, store, parts, steps, signed_a)
+
+    # a LONE attestation for A from slot 1, delivered at slot 2 (restrict the
+    # participant set so the boost-vs-one-attester property is what is tested)
+    attestation = get_valid_attestation(
+        spec, state_a, slot=spec.Slot(1), signed=True,
+        filter_participant_set=lambda committee: {next(iter(sorted(committee)))})
+    tick_to_slot_step(spec, store, steps, 2)
+    add_attestation_step(spec, store, parts, steps, attestation)
+
+    # timely competing block B at slot 2 on the genesis parent
+    state_b = state.copy()
+    block_b = build_empty_block(spec, state_b, spec.Slot(2))
+    block_b.body.graffiti = spec.Bytes32(b"\x0b" * 32)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    add_block_step(spec, store, parts, steps, signed_b)
+
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_b.message.hash_tree_root(), "boost should win the slot"
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_epoch_rejected(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 1)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    add_block_step(spec, store, parts, steps, signed)
+    # attestation targeting an epoch past the wall clock
+    attestation = get_valid_attestation(spec, state, slot=spec.Slot(1), signed=False)
+    attestation.data.target.epoch = spec.get_current_epoch(state) + 1
+    sign_attestation(spec, state, attestation)
+    add_attestation_step(spec, store, parts, steps, attestation, valid=False)
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_unknown_block_rejected(spec, state):
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 2)
+    attestation = get_valid_attestation(spec, state, slot=spec.Slot(0), signed=False)
+    attestation.data.beacon_block_root = spec.Root(b"\x99" * 32)
+    sign_attestation(spec, state, attestation)
+    add_attestation_step(spec, store, parts, steps, attestation, valid=False)
+    yield from finalize_steps(parts, steps)

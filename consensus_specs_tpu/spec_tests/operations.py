@@ -7,7 +7,12 @@ be rejected.
 Reference parity targets: test/phase0/block_processing/test_process_attestation.py,
 test_process_voluntary_exit.py (success + representative invalid cases).
 """
-from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+    sign_indexed_attestation,
+)
+from ..testlib.slashings import build_attester_slashing
 from ..testlib.context import (
     ALTAIR,
     BELLATRIX,
@@ -17,7 +22,7 @@ from ..testlib.context import (
     with_all_phases,
     with_phases,
 )
-from ..testlib.state import next_epoch, next_slots, transition_to
+from ..testlib.state import next_slots
 
 
 def _run_op(spec, state, name, operation, valid=True, part_name=None):
@@ -78,24 +83,10 @@ def test_attestation_wrong_index(spec, state):
     yield from _run_op(spec, state, "attestation", attestation, valid=False)
 
 
-def _build_voluntary_exit(spec, state, index):
-    from ..crypto import bls
-    from ..testlib.keys import privkeys
-
-    exit_msg = spec.VoluntaryExit(
-        epoch=spec.get_current_epoch(state), validator_index=index
-    )
-    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
-    signing_root = spec.compute_signing_root(exit_msg, domain)
-    return spec.SignedVoluntaryExit(
-        message=exit_msg, signature=bls.Sign(privkeys[index], signing_root)
-    )
-
-
-def _age_state_past_shard_committee_period(spec, state):
-    epochs = int(spec.config.SHARD_COMMITTEE_PERIOD)
-    slot = state.slot + epochs * spec.SLOTS_PER_EPOCH
-    spec.process_slots(state, slot)
+from ..testlib.voluntary_exits import (  # noqa: E402
+    age_state_past_shard_committee_period as _age_state_past_shard_committee_period,
+    build_voluntary_exit as _build_voluntary_exit,
+)
 
 
 @with_all_phases
@@ -344,3 +335,236 @@ def test_sync_aggregate_wrong_signature(spec, state):
     aggregate = build_sync_aggregate(spec, state)
     aggregate.sync_committee_signature = spec.BLSSignature(b"\x77" * 96)
     yield from _run_op(spec, state, "sync_aggregate", aggregate, valid=False)
+
+
+# --- breadth: more rejection surfaces per operation -------------------------
+
+@with_all_phases
+@spec_state_test
+def test_attestation_future_target_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.target.epoch = spec.get_current_epoch(state) + 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_wrong_source_checkpoint(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.root = b"\x31" * 32
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_bitlist_length_mismatch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    bits_type = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]
+    attestation.aggregation_bits = bits_type([True] * (len(committee) + 1))
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attestation_empty_participation_rejected_with_real_sig(spec, state):
+    """Zero aggregation bits: the aggregate of no signatures cannot verify
+    (the eth-infinity escape applies only to sync aggregates)."""
+    attestation = get_valid_attestation(spec, state, signed=False)
+    for i in range(len(attestation.aggregation_bits)):
+        attestation.aggregation_bits[i] = False
+    from ..crypto import bls as _bls
+
+    attestation.signature = spec.BLSSignature(_bls.G2_POINT_AT_INFINITY)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_future_epoch(spec, state):
+    from ..crypto import bls as _bls
+    from ..testlib.keys import privkeys
+
+    _age_state_past_shard_committee_period(spec, state)
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) + 1, validator_index=0)
+    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    signing_root = spec.compute_signing_root(exit_msg, domain)
+    signed = spec.SignedVoluntaryExit(
+        message=exit_msg, signature=_bls.Sign(privkeys[0], signing_root))
+    yield from _run_op(spec, state, "voluntary_exit", signed, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_voluntary_exit_wrong_signature(spec, state):
+    from ..crypto import bls as _bls
+    from ..testlib.keys import privkeys
+
+    _age_state_past_shard_committee_period(spec, state)
+    exit_msg = spec.VoluntaryExit(epoch=spec.get_current_epoch(state), validator_index=0)
+    signed = spec.SignedVoluntaryExit(
+        message=exit_msg, signature=_bls.Sign(privkeys[1], b"\x00" * 32))
+    yield from _run_op(spec, state, "voluntary_exit", signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_already_exited(spec, state):
+    _age_state_past_shard_committee_period(spec, state)
+    state.validators[0].exit_epoch = spec.get_current_epoch(state) + 10
+    exit_op = _build_voluntary_exit(spec, state, 0)
+    yield from _run_op(spec, state, "voluntary_exit", exit_op, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_surround_vote(spec, state):
+    """att1 surrounds att2 (source earlier, target later) — slashable."""
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
+    slashing = build_attester_slashing(spec, state, signed=False)
+    att1 = slashing.attestation_1
+    att2 = slashing.attestation_2
+    # make att1 surround att2: source(att1) < source(att2) < target(att2) < target(att1)
+    att2.data.source.epoch = att1.data.source.epoch + 1
+    att2.data.target.epoch = att1.data.target.epoch
+    att1.data.target.epoch = att1.data.target.epoch + 1
+    sign_indexed_attestation(spec, state, att1)
+    sign_indexed_attestation(spec, state, att2)
+    targets = set(att1.attesting_indices) & set(att2.attesting_indices)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=True)
+    assert targets and all(state.validators[int(i)].slashed for i in targets)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_no_overlap_rejected(spec, state):
+    slashing = build_attester_slashing(spec, state, signed=False)
+    half = len(slashing.attestation_1.attesting_indices) // 2
+    if half == 0:
+        return  # committee too small on this preset to split
+    idx = list(slashing.attestation_1.attesting_indices)
+    slashing.attestation_1.attesting_indices = idx[:half]
+    slashing.attestation_2.attesting_indices = idx[half:]
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_unsorted_indices_rejected(spec, state):
+    slashing = build_attester_slashing(spec, state, signed=False)
+    idx = list(slashing.attestation_1.attesting_indices)
+    if len(idx) < 2:
+        return
+    idx[0], idx[1] = idx[1], idx[0]
+    slashing.attestation_1.attesting_indices = idx
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_randao_wrong_reveal(spec, state):
+    from ..crypto import bls as _bls
+
+    body = spec.BeaconBlockBody()
+    body.randao_reveal = _bls.Sign(12345, b"\x00" * 32)  # wrong key + message
+    yield "pre", state.copy()
+    yield "randao", body
+    expect_assertion_error(lambda: spec.process_randao(state, body))
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_vote_accumulates(spec, state):
+    vote = spec.Eth1Data(
+        deposit_root=b"\x61" * 32,
+        deposit_count=state.eth1_data.deposit_count,
+        block_hash=b"\x62" * 32,
+    )
+    body = spec.BeaconBlockBody(eth1_data=vote)
+    yield "pre", state.copy()
+    yield "eth1_data", body
+    spec.process_eth1_data(state, body)
+    yield "post", state.copy()
+    assert len(state.eth1_data_votes) == 1
+    assert state.eth1_data_votes[0] == vote
+    # a single vote is not a period majority: eth1_data unchanged
+    assert state.eth1_data != vote
+
+
+# --- bellatrix execution payload -------------------------------------------
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_payload_post_merge_success(spec, state):
+    """After the merge, a consistent payload is accepted and recorded in the
+    latest execution payload header."""
+    from ..testlib.bellatrix import complete_merge_transition
+    from ..testlib.block import build_empty_execution_payload
+
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE)
+    yield "post", state.copy()
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_payload_post_merge_wrong_parent_hash(spec, state):
+    from ..testlib.bellatrix import complete_merge_transition
+    from ..testlib.block import build_empty_execution_payload
+
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = b"\x13" * 32
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE))
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_payload_post_merge_wrong_random(spec, state):
+    from ..testlib.bellatrix import complete_merge_transition
+    from ..testlib.block import build_empty_execution_payload
+
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.random = b"\x14" * 32
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE))
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_payload_post_merge_wrong_timestamp(spec, state):
+    from ..testlib.bellatrix import complete_merge_transition
+    from ..testlib.block import build_empty_execution_payload
+
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, payload, spec.EXECUTION_ENGINE))

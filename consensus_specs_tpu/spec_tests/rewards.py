@@ -9,7 +9,7 @@ the phase0/altair rewards suites.
 """
 from ..ssz.types import Container, List, uint64
 from ..testlib.attestations import add_attestations_for_epoch
-from ..testlib.context import ALTAIR, PHASE0, spec_state_test, with_all_phases
+from ..testlib.context import spec_state_test, with_all_phases
 from ..testlib.state import next_epoch, set_full_participation_previous_epoch
 
 

@@ -10,7 +10,7 @@ KZG trusted setup, exercising the degree-bound pairing and the joint
 builder+proposer FastAggregateVerify — the paths the kill-switch otherwise
 stubs (ADVICE r1: live-crypto-only bugs need live-crypto tests).
 """
-from ..crypto import bls, kzg, kzg_shim
+from ..crypto import kzg, kzg_shim
 from ..ssz import hash_tree_root
 from ..testlib.attestations import get_valid_attestation, sign_attestation
 from ..testlib.context import (

@@ -10,7 +10,7 @@ from __future__ import annotations
 from .merkle import next_power_of_two
 from .types import (
     BYTES_PER_CHUNK, Bitlist, Bitvector, ByteList, ByteVector, Container,
-    List, Union, Vector, _is_basic,
+    List, Vector, _is_basic,
 )
 
 GeneralizedIndex = int

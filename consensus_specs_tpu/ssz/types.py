@@ -13,7 +13,7 @@ Bitvector[N], Bitlist[N], ByteVector[N], ByteList[N], Union[...].
 """
 from __future__ import annotations
 
-from typing import Any, Iterable, Sequence
+from typing import Any, Sequence
 
 from .merkle import merkleize_chunks, mix_in_length, mix_in_selector
 
@@ -602,6 +602,9 @@ class _Sequence(SSZType):
 
     def index(self, v):
         return self._elems.index(v)
+
+    def count(self, v):
+        return self._elems.count(v)
 
     def __contains__(self, v):
         return v in self._elems

@@ -22,10 +22,13 @@ def build_attestation_data(spec, state, slot, index):
     else:
         epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
 
+    # COPY the checkpoint: aliasing the state's own object would let a test
+    # that edits attestation.data.source silently mutate the state and
+    # vacuously pass equality asserts
     if slot < current_epoch_start_slot:
-        source = state.previous_justified_checkpoint
+        source = state.previous_justified_checkpoint.copy()
     else:
-        source = state.current_justified_checkpoint
+        source = state.current_justified_checkpoint.copy()
 
     return spec.AttestationData(
         slot=slot,
@@ -171,3 +174,10 @@ def add_attestations_for_epoch(spec, state, epoch):
                 inclusion_delay=1,
                 proposer_index=spec.get_beacon_proposer_index(state),
             ))
+
+
+def sign_indexed_attestation(spec, state, indexed_attestation):
+    """Re-sign an IndexedAttestation after its data/indices were edited."""
+    participants = [int(i) for i in indexed_attestation.attesting_indices]
+    indexed_attestation.signature = sign_aggregate_attestation(
+        spec, state, indexed_attestation.data, participants)

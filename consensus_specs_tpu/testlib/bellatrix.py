@@ -1,0 +1,23 @@
+"""Bellatrix (merge) scenario helpers.
+
+Reference parity: test/helpers/execution_payload.py + the merge-transition
+setup the reference's bellatrix suites do inline."""
+from __future__ import annotations
+
+
+
+def complete_merge_transition(spec, state):
+    """Put `state` past the merge: install a non-empty latest execution
+    payload header so is_merge_transition_complete(state) is True."""
+    header = spec.ExecutionPayloadHeader(
+        block_hash=spec.Hash32(b"\x61" * 32),
+        parent_hash=spec.Hash32(b"\x60" * 32),
+        block_number=1,
+        gas_limit=30_000_000,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        random=spec.get_randao_mix(state, spec.get_current_epoch(state)),
+        base_fee_per_gas=spec.uint256(7),
+    )
+    state.latest_execution_payload_header = header
+    assert spec.is_merge_transition_complete(state)
+    return header

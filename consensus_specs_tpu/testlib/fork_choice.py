@@ -45,6 +45,11 @@ def add_block_step(spec, store, parts, steps, signed_block, valid=True):
             return None
         raise AssertionError("expected on_block to reject")
     spec.on_block(store, signed_block)
+    # the reference's add_block also routes the block's attestations into the
+    # fork choice (helpers/fork_choice.py:143) — this is what materializes
+    # checkpoint states for targets justified purely via blocks
+    for attestation in signed_block.message.body.attestations:
+        spec.on_attestation(store, attestation, is_from_block=True)
     steps.append(step)
     return root
 

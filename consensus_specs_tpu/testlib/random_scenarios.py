@@ -79,8 +79,12 @@ def random_block(spec, state, rng: Random):
     return block
 
 
-def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2):
-    """One composed scenario; yields the sanity-blocks vector parts."""
+def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2,
+                        epoch_boundary=False):
+    """One composed scenario; yields the sanity-blocks vector parts.
+
+    epoch_boundary: hop to the last slot of the epoch before the final block
+    so it crosses process_epoch with the randomized registry."""
     rng = Random(seed)
     randomize_state(spec, state, rng)
     if leak:
@@ -89,7 +93,12 @@ def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2):
         random_slot_skips(spec, state, rng)
     yield "pre", state.copy()
     signed = []
-    for _ in range(blocks):
+    for i in range(blocks):
+        if epoch_boundary and i == blocks - 1:
+            per_epoch = int(spec.SLOTS_PER_EPOCH)
+            to_boundary = per_epoch - 1 - (int(state.slot) % per_epoch)
+            if to_boundary:
+                next_slots(spec, state, to_boundary)
         block = random_block(spec, state, rng)
         signed.append(state_transition_and_sign_block(spec, state, block))
     yield "meta", "meta", {"blocks_count": len(signed)}

@@ -1,0 +1,24 @@
+"""Voluntary-exit scenario builders (reference parity: test/helpers/
+voluntary_exits.py)."""
+from __future__ import annotations
+
+from ..crypto import bls
+from .keys import privkeys
+
+
+def build_voluntary_exit(spec, state, index, epoch=None):
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) if epoch is None else epoch,
+        validator_index=index,
+    )
+    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    signing_root = spec.compute_signing_root(exit_msg, domain)
+    return spec.SignedVoluntaryExit(
+        message=exit_msg, signature=bls.Sign(privkeys[index], signing_root)
+    )
+
+
+def age_state_past_shard_committee_period(spec, state):
+    """Advance so validators satisfy the exit-eligibility age gate."""
+    epochs = int(spec.config.SHARD_COMMITTEE_PERIOD)
+    spec.process_slots(state, state.slot + epochs * spec.SLOTS_PER_EPOCH)

@@ -8,7 +8,7 @@ import pytest
 from consensus_specs_tpu.compiler import get_spec
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.testlib.attestations import next_epoch_with_attestations
-from consensus_specs_tpu.testlib.block import apply_empty_block, build_empty_block_for_next_slot
+from consensus_specs_tpu.testlib.block import apply_empty_block
 from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
 from consensus_specs_tpu.testlib.state import next_epoch, next_slots
 from consensus_specs_tpu.testlib.sync_committee import build_sync_aggregate, get_committee_indices

@@ -9,7 +9,6 @@ with real signatures.
 Named *_pairing* so `make testfast` skips it (device pairing compiles are
 tens of seconds on the CPU test host).
 """
-import numpy as np
 import pytest
 
 from consensus_specs_tpu.crypto import bls, bls_sig

@@ -2,7 +2,6 @@
 import random
 
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.ops import fp_jax as F
 

@@ -6,7 +6,6 @@ exact integer arithmetic mod p, including long mixed op chains that mimic the
 pairing tower's usage pattern.
 """
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.ops import fp_rns as R
 

@@ -5,7 +5,6 @@ tmp directory and checks the consensus-spec-tests output conventions:
 <preset>/<fork>/<runner>/<handler>/<suite>/<case>/ with pre/post
 .ssz_snappy parts that decompress and SSZ-decode back to valid states.
 """
-from pathlib import Path
 
 import pytest
 import yaml

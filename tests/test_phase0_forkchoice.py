@@ -9,7 +9,7 @@ from consensus_specs_tpu.compiler import get_spec
 from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.testlib.attestations import get_valid_attestation
 from consensus_specs_tpu.testlib.block import (
-    apply_empty_block, build_empty_block, sign_block, state_transition_and_sign_block,
+    build_empty_block, sign_block, state_transition_and_sign_block,
 )
 from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
 from consensus_specs_tpu.testlib.state import next_slots

@@ -1,0 +1,182 @@
+"""Static lint gate for the repo (reference parity: the flake8+mypy gate in
+/root/reference/linter.ini + Makefile:133-136).
+
+This image ships no flake8/mypy/ruff, so the gate is a focused AST linter
+covering the defect classes that have actually bitten this codebase plus the
+cheap universal ones:
+
+  F401  unused import
+  F811  redefinition of an imported/defined name by a def/class
+  B006  mutable default argument
+  B011  assert on a non-empty tuple (always true)
+  E722  bare except
+  E999  syntax error
+
+Exit code 1 on any finding; `# noqa` on the offending line suppresses. Usage: python tools/lint.py [paths...]
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = [
+    "consensus_specs_tpu",
+    "generators",
+    "tests",
+    "benches",
+    "tools",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+# names that modules legitimately import for re-export or side effects
+REEXPORT_HINTS = ("__init__.py",)
+
+
+class ImportTracker(ast.NodeVisitor):
+    def __init__(self):
+        self.imports: dict[str, ast.AST] = {}  # local name -> node
+        self.used: set[str] = set()
+        self.defs: dict[str, list[int]] = {}
+        self.findings: list[tuple[int, str, str]] = []
+
+    # --- collection ---------------------------------------------------------
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            self.imports[name] = node
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return  # compiler directives, not bindings to "use"
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            self.imports[name] = node
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def _register_def(self, node):
+        self.defs.setdefault(node.name, []).append(node.lineno)
+        if node.name in self.imports:
+            imp = self.imports[node.name]
+            self.findings.append(
+                (node.lineno, "F811",
+                 f"'{node.name}' shadows import from line {imp.lineno}"))
+
+    def visit_FunctionDef(self, node):
+        self._register_def(node)
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._register_def(node)
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self._register_def(node)
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for default in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (default.lineno, "B006", "mutable default argument"))
+
+    def visit_Assert(self, node):
+        if isinstance(node.test, ast.Tuple) and node.test.elts:
+            self.findings.append(
+                (node.lineno, "B011", "assert on a non-empty tuple is always true"))
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.findings.append((node.lineno, "E722", "bare except"))
+        self.generic_visit(node)
+
+
+def _noqa_suppresses(line: str, code: str) -> bool:
+    """bare `# noqa` suppresses everything; `# noqa: X,Y` only those codes."""
+    if "noqa" not in line:
+        return False
+    _, _, after = line.partition("noqa")
+    after = after.strip()
+    if not after.startswith(":"):
+        return True
+    codes = {c.strip().upper() for c in after[1:].split(",")}
+    return code.upper() in codes
+
+
+def lint_file(path: Path) -> list[str]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    tracker = ImportTracker()
+    tracker.visit(tree)
+
+    out = []
+    # F401: imported but never used (skip __init__ re-export surfaces and
+    # star-import collectors)
+    has_star = any(
+        isinstance(n, ast.ImportFrom) and any(a.name == "*" for a in n.names)
+        for n in ast.walk(tree)
+    )
+    exported = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    if isinstance(n.value, (ast.List, ast.Tuple)):
+                        exported = {
+                            e.value for e in n.value.elts
+                            if isinstance(e, ast.Constant)
+                        }
+    if path.name not in REEXPORT_HINTS and not has_star:
+        for name, node in tracker.imports.items():
+            if name in tracker.used or name in exported or name.startswith("_"):
+                continue
+            line = src.splitlines()[node.lineno - 1]
+            if _noqa_suppresses(line, "F401"):
+                continue
+            out.append(f"{path}:{node.lineno}: F401 '{name}' imported but unused")
+    for lineno, code, msg in tracker.findings:
+        line = src.splitlines()[lineno - 1] if lineno <= len(src.splitlines()) else ""
+        if _noqa_suppresses(line, code):
+            continue
+        out.append(f"{path}:{lineno}: {code} {msg}")
+    return out
+
+
+def main(argv) -> int:
+    roots = argv[1:] or DEFAULT_PATHS
+    files = []
+    for r in roots:
+        p = Path(r)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    findings = []
+    for f in files:
+        findings.extend(lint_file(f))
+    for line in findings:
+        print(line)
+    print(f"lint: {len(files)} files, {len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
