@@ -85,10 +85,22 @@ def bench_bls() -> tuple[float, float]:
 
 
 def main() -> None:
+    import contextlib
+
     import jax
 
-    vps, compile_s = bench_bls()
-    epoch_s = bench_epoch()
+    from consensus_specs_tpu.utils.profiling import timed, timings, trace
+
+    profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+    ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
+    with ctx:
+        with timed("bench_bls"):
+            vps, compile_s = bench_bls()
+        with timed("bench_epoch"):
+            epoch_s = bench_epoch()
+    if profile_dir:
+        print(f"# device trace written to {profile_dir}", file=sys.stderr)
+    print(f"# stage timings: {timings()}", file=sys.stderr)
     print(
         json.dumps(
             {

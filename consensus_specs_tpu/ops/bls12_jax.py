@@ -245,7 +245,59 @@ def f12_mul(x, y):
 
 
 def f12_sqr(x):
-    return f12_mul(x, x)
+    """Squaring via the Fp4 tower view (Chung-Hasan SQR3 shape): with
+    s = w^3 (s^2 = xi) and f = A + B·w + C·w^2, A,B,C in Fp4 = Fp2[s],
+
+        f^2 = (A^2 + 2BC·s) + (2AB + C^2·s)·w + (B^2 + 2AC)·w^2
+
+    3 Fp4 squarings + 3 Fp4 products = 54 Fp products vs the generic
+    f12_mul(x, x)'s 108, with the same one-reduction-per-coefficient
+    discipline (12 reductions). Differentially covered by every pairing
+    test plus test_f12_mul_sqr_inv_conj."""
+    c0, c1, c2, c3, c4, c5 = x
+    A = (c0, c3)
+    B = (c1, c4)
+    C = (c2, c5)
+
+    def fp4_mul_wide(u, v):
+        # (a + b·s)(c + d·s) = (ac + xi·bd) + (ad + bc)·s  — Karatsuba over
+        # Fp2, products kept WIDE
+        a, b = u
+        c, d = v
+        X = f2_stack([a, b, f2_add(a, b)])
+        Y = f2_stack([c, d, f2_add(c, d)])
+        M = f2_mul_wide(X, Y)
+        ac = (M[0][0], M[1][0])
+        bd = (M[0][1], M[1][1])
+        t = (M[0][2], M[1][2])
+        re = f2_add(ac, f2_mul_xi(bd))
+        im = f2_sub(f2_sub(t, ac), bd)
+        return (re, im)
+
+    def fp4_dbl(u):
+        return (f2_add(u[0], u[0]), f2_add(u[1], u[1]))
+
+    def fp4_mul_s(u):
+        # s·(a + b·s) = xi·b + a·s  (on wide values: xi fold is add/sub)
+        return (f2_mul_xi(u[1]), u[0])
+
+    A2 = fp4_mul_wide(A, A)
+    B2 = fp4_mul_wide(B, B)
+    C2 = fp4_mul_wide(C, C)
+    AB = fp4_mul_wide(A, B)
+    AC = fp4_mul_wide(A, C)
+    BC = fp4_mul_wide(B, C)
+
+    out0 = tuple(f2_add(p_, q_) for p_, q_ in zip(A2, fp4_mul_s(fp4_dbl(BC))))
+    out1 = tuple(f2_add(p_, q_) for p_, q_ in zip(fp4_dbl(AB), fp4_mul_s(C2)))
+    out2 = tuple(f2_add(p_, q_) for p_, q_ in zip(B2, fp4_dbl(AC)))
+
+    # one batched reduction for all 12 Fp coefficients
+    re = jnp.stack([out0[0][0], out1[0][0], out2[0][0], out0[1][0], out1[1][0], out2[1][0]])
+    im = jnp.stack([out0[0][1], out1[0][1], out2[0][1], out0[1][1], out1[1][1], out2[1][1]])
+    red = F.fp_mont_reduce(jnp.stack([re, im]))
+    rre, rim = red[0], red[1]
+    return tuple((rre[k], rim[k]) for k in range(6))
 
 
 _SPARSE_J = (0, 3, 5)

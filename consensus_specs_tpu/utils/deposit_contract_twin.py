@@ -1,0 +1,97 @@
+"""Statement-for-statement Python twin of solidity_deposit_contract/
+deposit_contract.sol.
+
+This image ships no solc/EVM, so the contract's algorithm is validated by
+keeping this twin in lockstep with the Solidity source (same storage layout,
+same loops, same byte concatenations) and differentially testing it against
+(a) the independent `utils/deposit_tree.DepositTree` and (b) the compiled
+spec's `hash_tree_root(DepositData)` + `process_deposit` Merkle check
+(tests/test_deposit_contract_twin.py). A change to the .sol file must be
+mirrored here or the tests lose their meaning — keep the structures parallel.
+"""
+from __future__ import annotations
+
+from hashlib import sha256 as _sha256
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+MAX_DEPOSIT_COUNT = 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1
+GWEI = 10**9
+ETHER = 10**18
+
+
+def sha256(b: bytes) -> bytes:
+    return _sha256(b).digest()
+
+
+def to_little_endian_64(value: int) -> bytes:
+    return value.to_bytes(8, "little")
+
+
+class DepositContractTwin:
+    def __init__(self):
+        self.branch = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        self.deposit_count = 0
+        self.zero_hashes = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH - 1):
+            self.zero_hashes[height + 1] = sha256(
+                self.zero_hashes[height] + self.zero_hashes[height]
+            )
+        self.events: list[dict] = []
+
+    def get_deposit_root(self) -> bytes:
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1 == 1:
+                node = sha256(self.branch[height] + node)
+            else:
+                node = sha256(node + self.zero_hashes[height])
+            size //= 2
+        return sha256(node + to_little_endian_64(self.deposit_count) + b"\x00" * 24)
+
+    def get_deposit_count(self) -> bytes:
+        return to_little_endian_64(self.deposit_count)
+
+    def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                signature: bytes, deposit_data_root: bytes, msg_value: int) -> None:
+        assert len(pubkey) == 48, "invalid pubkey length"
+        assert len(withdrawal_credentials) == 32, "invalid withdrawal_credentials length"
+        assert len(signature) == 96, "invalid signature length"
+
+        assert msg_value >= 1 * ETHER, "deposit value too low"
+        assert msg_value % GWEI == 0, "deposit value not multiple of gwei"
+        deposit_amount = msg_value // GWEI
+        assert deposit_amount <= 2**64 - 1, "deposit value too high"
+
+        # (the .sol emits the event here; Python has no revert, so the emit
+        # moves after the asserts to preserve the EVM's rollback atomicity)
+        pubkey_root = sha256(pubkey + b"\x00" * 16)
+        signature_root = sha256(
+            sha256(signature[:64]) + sha256(signature[64:] + b"\x00" * 32)
+        )
+        node = sha256(
+            sha256(pubkey_root + withdrawal_credentials)
+            + sha256(to_little_endian_64(deposit_amount) + b"\x00" * 24 + signature_root)
+        )
+        assert node == deposit_data_root, (
+            "reconstructed DepositData does not match supplied deposit_data_root"
+        )
+
+        assert self.deposit_count < MAX_DEPOSIT_COUNT, "merkle tree full"
+        self.events.append({
+            "pubkey": pubkey,
+            "withdrawal_credentials": withdrawal_credentials,
+            "amount": to_little_endian_64(deposit_amount),
+            "signature": signature,
+            "index": to_little_endian_64(self.deposit_count),
+        })
+        self.deposit_count += 1
+
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1 == 1:
+                self.branch[height] = node
+                return
+            node = sha256(self.branch[height] + node)
+            size //= 2
+        raise AssertionError("unreachable")

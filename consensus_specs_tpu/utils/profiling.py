@@ -1,0 +1,100 @@
+"""Tracing/profiling hooks (SURVEY.md §5: the reference has only wall-clock
+prints — real tracing is new surface this framework adds).
+
+Thin, dependency-tolerant wrappers over the JAX profiler:
+
+- `trace(logdir)`: context manager capturing a device trace viewable in
+  TensorBoard/XProf/Perfetto (`jax.profiler.trace`).
+- `annotate(name)`: labels a host-side region so it shows up inside the
+  trace timeline (`jax.profiler.TraceAnnotation`).
+- `annotate_fn(name)`: decorator form of the same.
+- `timed(name)`: lightweight wall-clock section timing that accumulates into
+  a process-global registry (`timings()`/`reset_timings()`), for the many
+  places a full device trace is overkill — e.g. per-stage numbers in
+  bench.py (`BENCH_PROFILE_DIR=/path`), generator hot-case forensics.
+
+Everything degrades to a no-op if the profiler is unavailable (e.g. a
+stripped CPU-only CI), so call sites never need to guard.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from functools import wraps
+
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a JAX device trace under `logdir` for the enclosed region."""
+    import jax
+
+    # only the profiler START is guarded: a body exception must propagate
+    # unchanged (a second yield under `except` would corrupt the generator)
+    try:
+        ctx = jax.profiler.trace(str(logdir))
+        ctx.__enter__()
+    except Exception:  # profiler backend unavailable: degrade to no-op
+        ctx = None
+    try:
+        yield
+    finally:
+        if ctx is not None:
+            with contextlib.suppress(Exception):
+                ctx.__exit__(None, None, None)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Label the enclosed host region in the active device trace."""
+    import jax
+
+    try:
+        ctx = jax.profiler.TraceAnnotation(name)
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+def annotate_fn(name: str | None = None):
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            with annotate(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+@contextlib.contextmanager
+def timed(name: str):
+    """Accumulate wall-clock time for `name` into the process registry."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _TIMINGS[name].append(time.perf_counter() - t0)
+
+
+def timings() -> dict[str, dict[str, float]]:
+    """{name: {count, total_s, mean_s, max_s}} snapshot."""
+    out = {}
+    for name, samples in _TIMINGS.items():
+        out[name] = {
+            "count": len(samples),
+            "total_s": round(sum(samples), 6),
+            "mean_s": round(sum(samples) / len(samples), 6),
+            "max_s": round(max(samples), 6),
+        }
+    return out
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

@@ -1,0 +1,165 @@
+// SPDX-License-Identifier: CC0-1.0
+pragma solidity 0.8.19;
+
+// The beacon-chain deposit contract: an append-only incremental Merkle tree
+// of DepositData hash-tree-roots, depth 32, with the deposit count mixed
+// into the root (specs/phase0/deposit-contract.md). Original implementation
+// of the specified algorithm for this framework; the Python twin used by
+// genesis tooling and the differential tests is
+// consensus_specs_tpu/utils/deposit_tree.py.
+
+interface IDepositContract {
+    /// A deposit was accepted; fields are little-endian encoded as clients
+    /// replay them into eth1 voting / genesis.
+    event DepositEvent(
+        bytes pubkey,
+        bytes withdrawal_credentials,
+        bytes amount,
+        bytes signature,
+        bytes index
+    );
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable;
+
+    function get_deposit_root() external view returns (bytes32);
+
+    function get_deposit_count() external view returns (bytes memory);
+}
+
+interface IERC165 {
+    function supportsInterface(bytes4 interfaceId) external pure returns (bool);
+}
+
+contract DepositContract is IDepositContract, IERC165 {
+    uint256 private constant DEPOSIT_CONTRACT_TREE_DEPTH = 32;
+    // one slot must stay free so the count mix-in can never collide with a
+    // full bottom layer
+    uint256 private constant MAX_DEPOSIT_COUNT = 2 ** DEPOSIT_CONTRACT_TREE_DEPTH - 1;
+
+    // branch[h]: the pending left-subtree root at height h (the right spine)
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private branch;
+    uint256 private deposit_count;
+
+    bytes32[DEPOSIT_CONTRACT_TREE_DEPTH] private zero_hashes;
+
+    constructor() {
+        // zero_hashes[0] defaults to 0x00...00; ladder up
+        for (uint256 height = 0; height < DEPOSIT_CONTRACT_TREE_DEPTH - 1; height++)
+            zero_hashes[height + 1] = sha256(
+                abi.encodePacked(zero_hashes[height], zero_hashes[height])
+            );
+    }
+
+    function get_deposit_root() external view override returns (bytes32) {
+        bytes32 node;
+        uint256 size = deposit_count;
+        for (uint256 height = 0; height < DEPOSIT_CONTRACT_TREE_DEPTH; height++) {
+            if ((size & 1) == 1)
+                node = sha256(abi.encodePacked(branch[height], node));
+            else
+                node = sha256(abi.encodePacked(node, zero_hashes[height]));
+            size /= 2;
+        }
+        return sha256(
+            abi.encodePacked(node, to_little_endian_64(uint64(deposit_count)), bytes24(0))
+        );
+    }
+
+    function get_deposit_count() external view override returns (bytes memory) {
+        return to_little_endian_64(uint64(deposit_count));
+    }
+
+    function deposit(
+        bytes calldata pubkey,
+        bytes calldata withdrawal_credentials,
+        bytes calldata signature,
+        bytes32 deposit_data_root
+    ) external payable override {
+        require(pubkey.length == 48, "DepositContract: invalid pubkey length");
+        require(
+            withdrawal_credentials.length == 32,
+            "DepositContract: invalid withdrawal_credentials length"
+        );
+        require(signature.length == 96, "DepositContract: invalid signature length");
+
+        require(msg.value >= 1 ether, "DepositContract: deposit value too low");
+        require(msg.value % 1 gwei == 0, "DepositContract: deposit value not multiple of gwei");
+        uint256 deposit_amount = msg.value / 1 gwei;
+        require(deposit_amount <= type(uint64).max, "DepositContract: deposit value too high");
+
+        emit DepositEvent(
+            pubkey,
+            withdrawal_credentials,
+            to_little_endian_64(uint64(deposit_amount)),
+            signature,
+            to_little_endian_64(uint64(deposit_count))
+        );
+
+        // hash_tree_root(DepositData) from scratch in EVM sha256:
+        // leaves: pubkey (48 -> two 32B chunks), credentials, amount+pad,
+        // signature (96 -> 3 chunks merkleized to depth 2)
+        bytes32 pubkey_root = sha256(abi.encodePacked(pubkey, bytes16(0)));
+        bytes32 signature_root = sha256(
+            abi.encodePacked(
+                sha256(abi.encodePacked(signature[:64])),
+                sha256(abi.encodePacked(signature[64:], bytes32(0)))
+            )
+        );
+        bytes32 node = sha256(
+            abi.encodePacked(
+                sha256(abi.encodePacked(pubkey_root, withdrawal_credentials)),
+                sha256(
+                    abi.encodePacked(
+                        to_little_endian_64(uint64(deposit_amount)),
+                        bytes24(0),
+                        signature_root
+                    )
+                )
+            )
+        );
+        require(
+            node == deposit_data_root,
+            "DepositContract: reconstructed DepositData does not match supplied deposit_data_root"
+        );
+
+        require(deposit_count < MAX_DEPOSIT_COUNT, "DepositContract: merkle tree full");
+        deposit_count += 1;
+
+        // incremental insert: merge left-subtree roots while the index bit
+        // is 0; the first 1 bit's level stores the merged node
+        uint256 size = deposit_count;
+        for (uint256 height = 0; height < DEPOSIT_CONTRACT_TREE_DEPTH; height++) {
+            if ((size & 1) == 1) {
+                branch[height] = node;
+                return;
+            }
+            node = sha256(abi.encodePacked(branch[height], node));
+            size /= 2;
+        }
+        assert(false); // unreachable: deposit_count < 2^32 - 1
+    }
+
+    function supportsInterface(bytes4 interfaceId) external pure override returns (bool) {
+        return
+            interfaceId == type(IERC165).interfaceId ||
+            interfaceId == type(IDepositContract).interfaceId;
+    }
+
+    function to_little_endian_64(uint64 value) internal pure returns (bytes memory ret) {
+        ret = new bytes(8);
+        bytes8 bytesValue = bytes8(value);
+        ret[0] = bytesValue[7];
+        ret[1] = bytesValue[6];
+        ret[2] = bytesValue[5];
+        ret[3] = bytesValue[4];
+        ret[4] = bytesValue[3];
+        ret[5] = bytesValue[2];
+        ret[6] = bytesValue[1];
+        ret[7] = bytesValue[0];
+    }
+}
