@@ -98,6 +98,10 @@ def main() -> None:
             vps, compile_s = bench_bls()
         with timed("bench_epoch"):
             epoch_s = bench_epoch()
+        with timed("bench_attestations"):
+            import benches.attestation_bench as att_bench
+
+            att_per_s, att_epoch_s, att_count = att_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -113,6 +117,10 @@ def main() -> None:
                     "bls_compile_s": round(compile_s, 1),
                     "process_epoch_1m_s": round(epoch_s, 4),
                     "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
+                    "attestations_per_sec": round(att_per_s, 1),
+                    "attestation_epoch_s": round(att_epoch_s, 4),
+                    "attestations_per_epoch": att_count,
+                    "attestation_validators": att_bench.default_validators(),
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -1,0 +1,92 @@
+"""BASELINE config 2: mainnet-preset attestation processing, one epoch,
+32k validators — the framework pipeline's marginal cost per attestation.
+
+Pipeline measured (device work; the protocol's per-epoch marginal cost):
+  1. committee shuffle: ONE `shuffled_index_map` kernel call for the epoch's
+     whole-registry permutation (the spec path's `accelerated_shuffle` hook),
+  2. batched signature verification: every aggregate attestation of the
+     epoch in one `pairing_check_batch` launch (committees/slot x 32 slots).
+
+Host prep (keys, hash-to-curve of the 32 attestation messages, per-committee
+pubkey aggregation) is excluded as amortized/cached, consistent with
+bench.py's BLS metric.
+
+Usage: python benches/attestation_bench.py [n_validators] — one JSON line.
+"""
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+def default_validators() -> int:
+    return int(os.environ.get("BENCH_ATT_VALIDATORS", 32_768))
+
+
+def run(n_validators: int | None = None):
+    """Returns (attestations_per_sec, epoch_wallclock_s, n_attestations)."""
+    import jax
+    import numpy as np
+
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.crypto.bls_jax import bench_pairing_args
+    from consensus_specs_tpu.ops import bls12_jax as K
+    from consensus_specs_tpu.ops.shuffle import seed_to_words, shuffled_index_map
+
+    if n_validators is None:
+        n_validators = default_validators()
+    # protocol constants from the compiled spec — the thing being measured
+    spec = get_spec("phase0", "mainnet")
+    SLOTS_PER_EPOCH = int(spec.SLOTS_PER_EPOCH)
+    SHUFFLE_ROUNDS = int(spec.SHUFFLE_ROUND_COUNT)
+    committees_per_slot = max(
+        1, min(int(spec.MAX_COMMITTEES_PER_SLOT),
+               n_validators // SLOTS_PER_EPOCH // int(spec.TARGET_COMMITTEE_SIZE)))
+    n_attestations = committees_per_slot * SLOTS_PER_EPOCH
+
+    seed_words = jax.device_put(seed_to_words(b"\x42" * 32))
+    pairing_args = bench_pairing_args(n_attestations)
+
+    def epoch(seed_words, args):
+        perm = shuffled_index_map(n_validators, seed_words, SHUFFLE_ROUNDS)
+        ok = K.pairing_check_batch(*args)
+        return perm, ok
+
+    # compile + correctness
+    t0 = time.time()
+    perm, ok = epoch(seed_words, pairing_args)
+    jax.block_until_ready(ok)
+    compile_s = time.time() - t0
+    assert bool(np.asarray(ok).all()), "valid attestation signatures rejected"
+    probe = min(1000, n_validators)
+    assert len(set(np.asarray(perm)[:probe].tolist())) == probe, "shuffle not a permutation?"
+    print(f"# attestation bench compile+first: {compile_s:.1f}s", file=sys.stderr)
+
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        perm, ok = epoch(seed_words, pairing_args)
+        jax.block_until_ready(ok)
+        times.append(time.time() - t0)
+    best = min(times)
+    return n_attestations / best, best, n_attestations
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else default_validators()
+    aps, epoch_s, n_att = run(n)
+    print(json.dumps({
+        "metric": "attestation_processing_throughput",
+        "value": round(aps, 1),
+        "unit": "attestations/sec/chip",
+        "vs_baseline": None,
+        "epoch_wallclock_s": round(epoch_s, 4),
+        "attestations_per_epoch": n_att,
+        "validators": n,
+    }))
+
+
+if __name__ == "__main__":
+    main()

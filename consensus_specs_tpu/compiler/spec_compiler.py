@@ -212,8 +212,35 @@ def _runtime_namespace() -> dict:
         "PyList": PyList, "Sequence": Sequence, "Set": Set, "Tuple": Tuple,
         "ceillog2": lambda x: (int(x) - 1).bit_length(),
         "floorlog2": lambda x: int(x).bit_length() - 1,
+        "accelerated_shuffle": _accelerated_shuffle,
     }
     return ns
+
+
+def _accelerated_shuffle(seed: bytes, index_count: int, rounds: int):
+    """Whole-registry shuffle map via the device kernel (ops/shuffle.py), or
+    None to make the caller fall back to the scalar spec loop.
+
+    Only engages when jax is ALREADY live in the process: importing jax here
+    would initialize accelerator plugins from inside pure-host tools
+    (generators, conformance replay), which must stay device-free. The spec's
+    committee path (reference setup.py:365-423's memoization profile) then
+    costs one kernel call per (seed, count) instead of count x 90 sha256s.
+    Set CONSENSUS_TPU_HOST_SHUFFLE=1 to force the scalar path.
+    """
+    import os
+    import sys
+
+    if index_count == 0 or "jax" not in sys.modules:
+        return None
+    if os.environ.get("CONSENSUS_TPU_HOST_SHUFFLE"):
+        return None
+    try:
+        from ..ops.shuffle import compute_shuffled_indices
+
+        return [int(x) for x in compute_shuffled_indices(index_count, seed, rounds)]
+    except Exception:
+        return None  # any kernel issue: the scalar loop is always correct
 
 
 _SPEC_CACHE: dict = {}
