@@ -58,8 +58,8 @@ def bench_epoch() -> float:
     return sorted(times)[len(times) // 2]
 
 
-def bench_bls() -> tuple[float, float]:
-    """(verifications/sec, compile_s) for a batch of N_BLS pairing checks."""
+def bench_bls() -> tuple[float, float, float]:
+    """(per-item verifies/sec, RLC verifies/sec, compile_s) at batch N_BLS."""
     import time as _time
 
     import jax
@@ -81,7 +81,22 @@ def bench_bls() -> tuple[float, float]:
         t0 = _time.time()
         K.pairing_check_batch(*args).block_until_ready()
         times.append(_time.time() - t0)
-    return N_BLS / min(times), compile_s
+    per_item = N_BLS / min(times)
+
+    # randomized batch check (shared final exponentiation) — the deferred
+    # flush's large-batch path
+    from consensus_specs_tpu.crypto.bls_jax import random_zbits
+
+    zbits = random_zbits(N_BLS)
+    ok = K.pairing_check_rlc(*args, zbits)
+    ok.block_until_ready()
+    assert bool(np.asarray(ok))
+    rlc_times = []
+    for _ in range(3):
+        t0 = _time.time()
+        K.pairing_check_rlc(*args, zbits).block_until_ready()
+        rlc_times.append(_time.time() - t0)
+    return per_item, N_BLS / min(rlc_times), compile_s
 
 
 def main() -> None:
@@ -95,7 +110,7 @@ def main() -> None:
     ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
     with ctx:
         with timed("bench_bls"):
-            vps, compile_s = bench_bls()
+            vps, rlc_vps, compile_s = bench_bls()
         with timed("bench_epoch"):
             epoch_s = bench_epoch()
         with timed("bench_attestations"):
@@ -114,6 +129,7 @@ def main() -> None:
                 "vs_baseline": round(vps / BLS_TARGET, 4),
                 "extra": {
                     "bls_batch": N_BLS,
+                    "bls_verify_throughput_rlc": round(rlc_vps, 1),
                     "bls_compile_s": round(compile_s, 1),
                     "process_epoch_1m_s": round(epoch_s, 4),
                     "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),

@@ -14,11 +14,14 @@ def main(argv=None) -> int:
     parser.add_argument("root")
     parser.add_argument("--runners", default=None, help="comma-separated runner filter")
     parser.add_argument("--presets", default=None, help="comma-separated preset filter")
+    parser.add_argument("--workers", type=int, default=1,
+                        help="process-pool width (reference `pytest -n N` parity)")
     ns = parser.parse_args(argv)
     summary = replay_tree(
         ns.root,
         runners=set(ns.runners.split(",")) if ns.runners else None,
         presets=set(ns.presets.split(",")) if ns.presets else None,
+        workers=ns.workers,
     )
     for r in summary.failed:
         print(f"FAIL {r.path}: {r.detail}")

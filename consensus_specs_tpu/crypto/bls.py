@@ -179,7 +179,13 @@ def AggregatePKs(pubkeys) -> bytes:
     verification — a stub here would bake fake bytes into states and make
     vectors generated with BLS on irreproducible by a BLS-off replay
     (bls_setting 0 means verification is optional, never that state
-    contents change)."""
+    contents change). Large aggregates route through the device G1
+    reduction tree under the jax backend (512-member sync committees are
+    one kernel launch instead of 511 host point-adds)."""
+    from . import bls_jax
+
+    if _backend == "jax" and len(pubkeys) >= bls_jax.DEVICE_AGGREGATE_MIN:
+        return bls_jax.aggregate_pubkeys_device(pubkeys)
     return _py.AggregatePKs(pubkeys)
 
 

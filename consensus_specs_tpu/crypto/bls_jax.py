@@ -28,6 +28,10 @@ _NEG_G1 = (_G1[0], (-_G1[1]) % oracle.P)
 _G2 = oracle.G2_GEN_AFF
 
 _MIN_BATCH = 8
+# batches at least this big use the shared-final-exponentiation randomized
+# check first (one final exp for the whole batch); only a failing batch pays
+# the per-item pass for attribution
+RLC_MIN_BATCH = 16
 
 
 def _bucket(n: int) -> int:
@@ -45,28 +49,8 @@ def _device_check(p1s, q1s, p2s, q2s) -> np.ndarray:
     from ..ops import bls12_jax as K
 
     n = len(p1s)
-    b = _bucket(n)
-    pad = b - n
-    p1s = list(p1s) + [_G1] * pad
-    q1s = list(q1s) + [_G2] * pad
-    p2s = list(p2s) + [_NEG_G1] * pad
-    q2s = list(q2s) + [_G2] * pad
-
-    enc = K.F.ints_to_mont_batch
-
-    def g1_coords(pts):
-        return enc([p[0] for p in pts]), enc([p[1] for p in pts])
-
-    def g2_coords(pts):
-        x = (enc([p[0][0] for p in pts]), enc([p[0][1] for p in pts]))
-        y = (enc([p[1][0] for p in pts]), enc([p[1][1] for p in pts]))
-        return x, y
-
-    px, py = g1_coords(p1s)
-    qx, qy = g2_coords(q1s)
-    p2x, p2y = g1_coords(p2s)
-    q2x, q2y = g2_coords(q2s)
-    ok = K.pairing_check_batch(qx, qy, px, py, q2x, q2y, p2x, p2y)
+    _, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
+    ok = K.pairing_check_batch(*args)
     return np.asarray(jax.device_get(ok))[:n]
 
 
@@ -128,19 +112,80 @@ def make_fast_aggregate_check(pubkeys, message, signature) -> QueuedCheck | None
     return QueuedCheck(agg, hm, _NEG_G1, sig)
 
 
+def random_zbits(n: int):
+    """(n, 64) bool device array of host-drawn nonzero 64-bit scalars — the
+    randomness input of pairing_check_rlc (single shared packing helper)."""
+    import secrets
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    zs = [secrets.randbelow(2**64 - 1) + 1 for _ in range(n)]
+    return jnp.asarray(
+        np.array([[(z >> i) & 1 for i in range(64)] for z in zs], dtype=bool))
+
+
+def _pack_pairing_args(p1s, q1s, p2s, q2s):
+    """Pad to the bucket and encode into pairing_check_* positional args."""
+    from ..ops import bls12_jax as K
+
+    n = len(p1s)
+    b = _bucket(n)
+    pad = b - n
+    p1s = list(p1s) + [_G1] * pad
+    q1s = list(q1s) + [_G2] * pad
+    p2s = list(p2s) + [_NEG_G1] * pad
+    q2s = list(q2s) + [_G2] * pad
+    enc = K.F.ints_to_mont_batch
+
+    def g1_coords(pts):
+        return enc([p[0] for p in pts]), enc([p[1] for p in pts])
+
+    def g2_coords(pts):
+        x = (enc([p[0][0] for p in pts]), enc([p[0][1] for p in pts]))
+        y = (enc([p[1][0] for p in pts]), enc([p[1][1] for p in pts]))
+        return x, y
+
+    px, py = g1_coords(p1s)
+    qx, qy = g2_coords(q1s)
+    p2x, p2y = g1_coords(p2s)
+    q2x, q2y = g2_coords(q2s)
+    return b, (qx, qy, px, py, q2x, q2y, p2x, p2y)
+
+
+def _device_check_all(p1s, q1s, p2s, q2s) -> bool:
+    """Single-bool randomized batch check (pairing_check_rlc) with host-drawn
+    64-bit scalars; soundness error 2^-64 per flush."""
+    import jax
+    import numpy as np
+
+    from ..ops import bls12_jax as K
+
+    b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
+    ok = K.pairing_check_rlc(*args, random_zbits(b))
+    return bool(np.asarray(jax.device_get(ok)))
+
+
 def run_checks(checks) -> np.ndarray:
     """Execute a list of QueuedCheck | None on device; None -> False."""
     live = [(i, c) for i, c in enumerate(checks) if c is not None]
     out = np.zeros(len(checks), dtype=bool)
-    if live:
-        res = _device_check(
-            [c.p1 for _, c in live],
-            [c.q1 for _, c in live],
-            [c.p2 for _, c in live],
-            [c.q2 for _, c in live],
-        )
-        for (i, _), ok in zip(live, res):
-            out[i] = bool(ok)
+    if not live:
+        return out
+    cols = (
+        [c.p1 for _, c in live],
+        [c.q1 for _, c in live],
+        [c.p2 for _, c in live],
+        [c.q2 for _, c in live],
+    )
+    if len(live) >= RLC_MIN_BATCH and _device_check_all(*cols):
+        for i, _ in live:
+            out[i] = True
+        return out
+    # small batch, or the randomized check failed: per-item attribution
+    res = _device_check(*cols)
+    for (i, _), ok in zip(live, res):
+        out[i] = bool(ok)
     return out
 
 
@@ -186,3 +231,41 @@ def bench_pairing_args(n: int, distinct: int = 8):
         dev(tile(enc([_NEG_G1[0]] * distinct))),
         dev(tile(enc([_NEG_G1[1]] * distinct))),
     )
+
+
+DEVICE_AGGREGATE_MIN = 32  # below this, host point-adds beat a kernel launch
+
+
+def aggregate_pubkeys_device(pubkeys) -> bytes:
+    """Aggregate compressed G1 pubkeys via the device reduction tree
+    (ops/bls12_jax.g1_sum_reduce — the SURVEY §2.3 G1-collective component).
+
+    Raises ValueError on any invalid/infinity input, mirroring the host
+    oracle's AggregatePKs contract."""
+    import jax.numpy as jnp
+
+    from ..ops import bls12_jax as K
+
+    if len(pubkeys) == 0:
+        raise ValueError("aggregate of empty pubkey list")
+    affs = []
+    for pk in pubkeys:
+        aff = g1_from_bytes(bytes(pk))
+        if aff is None:
+            raise ValueError("infinity pubkey in aggregate")
+        affs.append(aff)
+    enc = K.F.ints_to_mont_batch
+    X = jnp.asarray(enc([a[0] for a in affs]))
+    Y = jnp.asarray(enc([a[1] for a in affs]))
+    Z = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), X.shape)
+    total = K.g1_sum_reduce((X, Y, Z))
+    import numpy as np
+
+    from .bls12_381 import g1_to_bytes
+
+    if bool(np.asarray(K.F.fp_is_zero(total[2]))):
+        return g1_to_bytes(None)  # sum is infinity: canonical 0xc0 encoding
+    sx, sy = K.g1_to_affine(total)
+    x = K.F.from_mont_int(np.asarray(sx))
+    y = K.F.from_mont_int(np.asarray(sy))
+    return g1_to_bytes((x, y))

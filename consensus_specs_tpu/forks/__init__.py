@@ -1,0 +1,50 @@
+"""Fork registry: the single place that knows the fork lineage and how to
+cross boundaries.
+
+Reference parity: the role of `spec_builders`/`combine_spec_objects` fork
+bookkeeping in the reference's setup.py (:446,492,551-554) plus the
+`with_fork_metas` transition vocabulary (context.py:564). The compiler owns
+document overlays (compiler/spec_compiler.py FORK_ORDER); this package owns
+the runtime questions: what comes after X, how a state upgrades at a
+boundary, and which forks are stable vs R&D.
+"""
+from __future__ import annotations
+
+from ..compiler.spec_compiler import FORK_ORDER, PREVIOUS_FORK, get_spec
+
+STABLE_FORKS = ("phase0", "altair", "bellatrix")
+RND_FORKS = ("sharding", "custody_game")
+
+UPGRADE_FN = {
+    "altair": "upgrade_to_altair",
+    "bellatrix": "upgrade_to_bellatrix",
+}
+
+
+def previous_fork(fork: str) -> str | None:
+    return PREVIOUS_FORK[fork]
+
+
+def next_fork(fork: str) -> str | None:
+    i = FORK_ORDER.index(fork)
+    return FORK_ORDER[i + 1] if i + 1 < len(FORK_ORDER) else None
+
+
+def is_post(fork: str, milestone: str) -> bool:
+    """True when `fork` is `milestone` or any later fork."""
+    return FORK_ORDER.index(fork) >= FORK_ORDER.index(milestone)
+
+
+def upgrade_state(pre_state, to_fork: str, preset: str):
+    """Upgrade a pre-fork state across the `to_fork` boundary using the
+    post-fork spec's upgrade function (specs/<fork>/fork.md)."""
+    fn_name = UPGRADE_FN.get(to_fork)
+    if fn_name is None:
+        raise ValueError(f"no upgrade function for fork {to_fork!r}")
+    post_spec = get_spec(to_fork, preset)
+    return getattr(post_spec, fn_name)(pre_state)
+
+
+def fork_lineage(fork: str) -> list[str]:
+    """The overlay chain phase0..fork, oldest first."""
+    return FORK_ORDER[: FORK_ORDER.index(fork) + 1]

@@ -771,3 +771,86 @@ def pairing_check_batch(qx, qy, px, py, q2x, q2y, p2x, p2y):
     m1 = miller_loop_batch(qx, qy, px, py)
     m2 = miller_loop_batch(q2x, q2y, p2x, p2y)
     return f12_is_one(final_exponentiation_batch(f12_mul(m1, m2)))
+
+
+# --- randomized batch check: ONE final exponentiation for the whole batch ---
+
+
+def g1_scalar_mul_batch(pt, bits):
+    """[z]P per item: double-and-add over `bits` ((..., nbits) bool, LSB
+    first). Jacobian in/out; complete g1_add handles the infinity start."""
+    X, Y, Z = pt
+    inf = (jnp.zeros_like(X), jnp.zeros_like(Y), jnp.zeros_like(Z))
+    nbits = bits.shape[-1]
+
+    def body(i, carry):
+        acc, add = carry
+        added = g1_add(acc, add)
+        sel = bits[..., i]
+
+        def pick(a, b):
+            return jnp.where(sel[..., None], a, b)
+
+        acc = (pick(added[0], acc[0]), pick(added[1], acc[1]), pick(added[2], acc[2]))
+        add = g1_double(add)
+        return acc, add
+
+    acc, _ = jax.lax.fori_loop(0, nbits, body, (inf, pt))
+    return acc
+
+
+def _g1_jacobian_to_affine_batch(pt):
+    X, Y, Z = pt
+    zinv = F.fp_inv(Z)
+    zinv2 = F.fp_mont_sqr(zinv)
+    M = F.fp_mont_mul(
+        jnp.stack(jnp.broadcast_arrays(X, Y)),
+        jnp.stack(jnp.broadcast_arrays(zinv2, F.fp_mont_mul(zinv, zinv2))),
+    )
+    return M[0], M[1]
+
+
+def f12_prod_reduce(f):
+    """Tree-product of a batch of Fp12 values over the leading axis."""
+    n = f[0][0].shape[0]
+    while n > 1:
+        half = n // 2
+        even = tuple((c[0][: 2 * half : 2], c[1][: 2 * half : 2]) for c in f)
+        odd = tuple((c[0][1 : 2 * half : 2], c[1][1 : 2 * half : 2]) for c in f)
+        prod = f12_mul(even, odd)
+        if n % 2:
+            prod = tuple(
+                (jnp.concatenate([c[0], f[k][0][-1:]]), jnp.concatenate([c[1], f[k][1][-1:]]))
+                for k, c in enumerate(prod)
+            )
+        f = prod
+        n = f[0][0].shape[0]
+    return f
+
+
+@jax.jit
+def pairing_check_rlc(qx, qy, px, py, q2x, q2y, p2x, p2y, zbits):
+    """Randomized batch verification with a SHARED final exponentiation:
+
+        prod_i [ e(z_i·P1_i, Q1_i) · e(z_i·P2_i, Q2_i) ] == 1
+
+    `zbits`: (N, 64) bool — independent uniform random scalars supplied by
+    the HOST per flush (z=0 is excluded by the caller). If every per-item
+    check holds the product is 1; a cheating batch passes with probability
+    2^-64 over the choice of z (standard Schwartz-Zippel batching, the same
+    scheme native BLS libraries use for aggregate verification). Returns a
+    scalar bool — callers needing attribution re-check per item.
+
+    vs pairing_check_batch: trades N final exponentiations (~1/3 of total
+    cost) for 2N 64-bit G1 scalar multiplications (~1/8), net ~25% faster
+    at large N."""
+    one = jnp.broadcast_to(jnp.asarray(F.ONE_MONT), px.shape).astype(px.dtype)
+    z1 = g1_scalar_mul_batch((px, py, one), zbits)
+    z2 = g1_scalar_mul_batch((p2x, p2y, one), zbits)
+    a1x, a1y = _g1_jacobian_to_affine_batch(z1)
+    a2x, a2y = _g1_jacobian_to_affine_batch(z2)
+    m1 = miller_loop_batch(qx, qy, a1x, a1y)
+    m2 = miller_loop_batch(q2x, q2y, a2x, a2y)
+    prod = f12_prod_reduce(f12_mul(m1, m2))
+    single = tuple((c[0][0], c[1][0]) for c in prod)
+    return f12_is_one(final_exponentiation_batch(single))

@@ -1,0 +1,196 @@
+"""Multi-host "gossip" load driver — the DCN side of the distributed story.
+
+The reference specifies its network layer as prose and never executes it
+(SURVEY.md §5: "distributed communication backend: none implemented"). This
+framework keeps the vectors-as-test-bus stance for conformance but ships the
+piece the reference leaves to clients: a host-side driver that plays the
+gossip layer's role for multi-host load runs. Each node is a separate OS
+process (one per host/slice in a real deployment) that:
+
+  1. produces its share of signed attestation messages for the slot,
+  2. floods them to every peer over TCP (localhost stands in for DCN),
+     framed exactly like the wire contract in specs/phase0/p2p-interface.md:
+     snappy BLOCK compression and the 20-byte
+     SHA256(MESSAGE_DOMAIN_VALID_SNAPPY ‖ ssz) message-id for dedup,
+  3. collects the slot's messages from peers, deduplicates by message-id,
+  4. verifies the whole collected batch in ONE deferred-BLS flush
+     (crypto/bls.deferred_verification — the same bulk path
+     state_transition uses, which on device is one pairing_check_batch).
+
+The intra-host/ICI half of the distributed design lives in parallel/mesh.py
+(sharded epoch engine + GSPMD collectives); this driver is the inter-host
+half. Convergence invariant checked by the tests: after each slot barrier,
+every node holds the identical message set.
+"""
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+_LEN = struct.Struct("<I")
+
+
+def message_id(ssz_bytes: bytes) -> bytes:
+    """20-byte gossip message-id (p2p-interface.md gossip domain)."""
+    return hashlib.sha256(MESSAGE_DOMAIN_VALID_SNAPPY + ssz_bytes).digest()[:20]
+
+
+def encode_message(ssz_bytes: bytes) -> bytes:
+    from ..native.snappy import compress
+
+    return compress(ssz_bytes)
+
+
+def decode_message(wire: bytes) -> bytes:
+    from ..native.snappy import decompress
+
+    return decompress(wire)
+
+
+# --- framing over a stream socket -------------------------------------------
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket) -> bytes | None:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# --- node -------------------------------------------------------------------
+
+
+@dataclass
+class NodeStats:
+    produced: int = 0
+    received: int = 0
+    duplicates: int = 0
+    verified_batches: int = 0
+    message_ids: set = field(default_factory=set)
+
+
+class GossipNode:
+    """One gossip participant: a listener plus dial-out links to peers."""
+
+    def __init__(self, node_id: int, listen_port: int, peer_ports: list[int]):
+        self.node_id = node_id
+        self.listen_port = listen_port
+        self.peer_ports = peer_ports
+        self.stats = NodeStats()
+        self.inbox: list[bytes] = []  # decompressed ssz payloads
+        self._lock = threading.Lock()
+        self._server = socket.create_server(("127.0.0.1", listen_port))
+        self._server.settimeout(10.0)
+        self._accepted: list[socket.socket] = []
+        self._links: list[socket.socket] = []
+        self._rx_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def accept_peers(self, count: int) -> None:
+        for _ in range(count):
+            conn, _ = self._server.accept()
+            self._accepted.append(conn)
+            t = threading.Thread(target=self._rx_loop, args=(conn,), daemon=True)
+            t.start()
+            self._rx_threads.append(t)
+
+    def dial_peers(self) -> None:
+        for port in self.peer_ports:
+            s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+            self._links.append(s)
+
+    def _rx_loop(self, conn: socket.socket) -> None:
+        conn.settimeout(30.0)
+        while not self._stop.is_set():
+            try:
+                wire = recv_frame(conn)
+            except (TimeoutError, OSError):
+                break
+            if wire is None:
+                break
+            ssz = decode_message(wire)
+            mid = message_id(ssz)
+            with self._lock:
+                if mid in self.stats.message_ids:
+                    self.stats.duplicates += 1
+                    continue
+                self.stats.message_ids.add(mid)
+                self.stats.received += 1
+                self.inbox.append(ssz)
+
+    # -- slot actions ---------------------------------------------------------
+
+    def publish(self, ssz_payloads: list[bytes]) -> None:
+        """Flood locally produced messages to every peer."""
+        with self._lock:
+            for ssz in ssz_payloads:
+                mid = message_id(ssz)
+                if mid not in self.stats.message_ids:
+                    self.stats.message_ids.add(mid)
+                    self.inbox.append(ssz)
+                    self.stats.produced += 1
+        for ssz in ssz_payloads:
+            wire = encode_message(ssz)
+            for link in self._links:
+                send_frame(link, wire)
+
+    def drain_and_verify(self, verify_fn) -> int:
+        """Verify everything collected so far in one deferred-BLS flush."""
+        from ..crypto import bls
+
+        with self._lock:
+            batch = list(self.inbox)
+            self.inbox.clear()
+        if batch:
+            with bls.deferred_verification():
+                for ssz in batch:
+                    verify_fn(ssz)
+            self.stats.verified_batches += 1
+        return len(batch)
+
+    def close(self) -> None:
+        self._stop.set()
+        for s in self._links + self._accepted:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._server.close()
+
+
+# --- full-mesh topology helper ----------------------------------------------
+
+
+def connect_full_mesh(nodes: list[GossipNode]) -> None:
+    """Dial every node to every other; each accepts n-1 inbound links."""
+    n = len(nodes)
+    acceptors = [
+        threading.Thread(target=node.accept_peers, args=(n - 1,)) for node in nodes
+    ]
+    for t in acceptors:
+        t.start()
+    for node in nodes:
+        node.dial_peers()
+    for t in acceptors:
+        t.join(timeout=15.0)

@@ -51,6 +51,8 @@ ALL_PHASES = (PHASE0, ALTAIR, BELLATRIX)
 MINIMAL = "minimal"
 MAINNET = "mainnet"
 DEFAULT_TEST_PRESET = MINIMAL
+# pytest --fork sets this to pin the decorator matrix to one fork
+FORK_RESTRICTION: str | None = None
 
 
 # --- part collection (vector_test dual-mode) --------------------------------
@@ -212,7 +214,14 @@ def with_phases(phases, other_phases=None):
         @functools.wraps(fn)
         def wrapper(preset=None, fork=None, generator_mode=False, bls_active=None, **kwargs):
             preset = preset or DEFAULT_TEST_PRESET
-            run_forks = [fork] if fork else list(phases)
+            if fork is None and FORK_RESTRICTION is not None:
+                if FORK_RESTRICTION not in phases:
+                    import pytest as _pytest
+
+                    _pytest.skip(f"test does not cover fork {FORK_RESTRICTION}")
+                run_forks = [FORK_RESTRICTION]
+            else:
+                run_forks = [fork] if fork else list(phases)
             results = {}
             prev_bls = bls.bls_active
             if bls_active is not None:

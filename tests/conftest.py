@@ -19,6 +19,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
+import pytest
 
 jax.config.update("jax_platforms", "cpu")
 try:
@@ -27,3 +28,38 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:  # pragma: no cover - jax internals moved; cpu select still set
     pass
+
+
+# --- reference-parity CLI flags (test/conftest.py --preset/--fork/--bls-type)
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--preset", default=None,
+        help="run spec tests on this preset (default: minimal)")
+    parser.addoption(
+        "--fork", default=None,
+        help="restrict decorator-matrix spec tests to one fork")
+    parser.addoption(
+        "--bls", choices=["on", "off"], default=None,
+        help="force the BLS kill-switch for the whole run")
+
+
+def pytest_configure(config):
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.testlib import context
+
+    preset = config.getoption("--preset")
+    if preset:
+        context.DEFAULT_TEST_PRESET = preset
+    fork = config.getoption("--fork")
+    if fork:
+        from consensus_specs_tpu.compiler.spec_compiler import FORK_ORDER
+
+        if fork not in FORK_ORDER:
+            raise pytest.UsageError(
+                f"--fork {fork!r} unknown (choose from {FORK_ORDER})")
+        context.FORK_RESTRICTION = fork
+    bls_opt = config.getoption("--bls")
+    if bls_opt:
+        bls.bls_active = bls_opt == "on"

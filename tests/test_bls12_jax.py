@@ -200,3 +200,42 @@ def test_cyclotomic_sqr_matches_generic_pairing():
     got = K.f12_from_device(K.f12_cyclotomic_sqr(f))
     want = K.f12_from_device(K.f12_sqr(f))
     assert got == want
+
+
+def test_pairing_check_rlc_pairing():
+    """Shared-final-exp randomized batch check: all-valid passes, one bad
+    item fails, on a 4-item batch (RNS backend)."""
+    from consensus_specs_tpu.crypto.bls_jax import random_zbits
+
+    def dev_f2pair(q):
+        x, y = K.f2_to_device(q[0]), K.f2_to_device(q[1])
+        return (x[0], x[1]), (y[0], y[1])
+
+    def tile4(arr):
+        return jnp.broadcast_to(arr, (4,) + arr.shape)
+
+    a = 13
+    pa, _ = _pairing_inputs(a, 1)
+    _, qa = _pairing_inputs(1, a)
+    g1 = oracle.G1_GEN_AFF
+    g2 = oracle.G2_GEN_AFF
+    neg_g1 = (g1[0], (-g1[1]) % K.P)
+
+    qx1, qy1 = dev_f2pair(g2)
+    qx2, qy2 = dev_f2pair(qa)
+    args_valid = (
+        (tile4(qx1[0]), tile4(qx1[1])), (tile4(qy1[0]), tile4(qy1[1])),
+        tile4(K.fp_to_device(pa[0])), tile4(K.fp_to_device(pa[1])),
+        (tile4(qx2[0]), tile4(qx2[1])), (tile4(qy2[0]), tile4(qy2[1])),
+        tile4(K.fp_to_device(neg_g1[0])), tile4(K.fp_to_device(neg_g1[1])),
+    )
+    zbits = random_zbits(4)
+    assert bool(K.pairing_check_rlc(*args_valid, zbits))
+
+    # corrupt item 2: replace -G1 with +G1 in the second pairing
+    p2x = np.asarray(args_valid[6]).copy()
+    p2y = np.asarray(args_valid[7]).copy()
+    p2x[2] = np.asarray(K.fp_to_device(g1[0]))
+    p2y[2] = np.asarray(K.fp_to_device(g1[1]))
+    args_bad = args_valid[:6] + (jnp.asarray(p2x), jnp.asarray(p2y))
+    assert not bool(K.pairing_check_rlc(*args_bad, zbits))

@@ -111,3 +111,21 @@ def test_meta_bls_setting_written(tmp_path):
     )
     meta = yaml.safe_load((case / "meta.yaml").read_text())
     assert meta["bls_setting"] == 1
+
+
+def test_fork_registry():
+    from consensus_specs_tpu import forks
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.testlib.context import _cached_genesis, default_balances
+
+    assert forks.next_fork("phase0") == "altair"
+    assert forks.previous_fork("altair") == "phase0"
+    assert forks.is_post("bellatrix", "altair")
+    assert not forks.is_post("phase0", "altair")
+    assert forks.fork_lineage("bellatrix") == ["phase0", "altair", "bellatrix"]
+
+    spec = get_spec("phase0", "minimal")
+    pre = _cached_genesis(spec, default_balances, lambda s: s.MAX_EFFECTIVE_BALANCE)
+    post = forks.upgrade_state(pre, "altair", "minimal")
+    assert hasattr(post, "current_sync_committee")
+    assert len(post.validators) == len(pre.validators)

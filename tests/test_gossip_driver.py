@@ -1,0 +1,94 @@
+"""Multi-node gossip driver: wire framing, dedup, convergence, and the
+deferred-BLS verification hookup (SURVEY §2.3 multi-host driver row)."""
+import threading
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.parallel.gossip_driver import (
+    GossipNode,
+    connect_full_mesh,
+    decode_message,
+    encode_message,
+    message_id,
+)
+from consensus_specs_tpu.ssz import serialize
+from consensus_specs_tpu.testlib.attestations import get_valid_attestation
+from consensus_specs_tpu.testlib.context import _cached_genesis, default_balances
+
+BASE_PORT = 19300
+
+
+def test_message_framing_roundtrip():
+    payload = b"\x07" * 300 + b"gossip payload" * 9
+    wire = encode_message(payload)
+    assert decode_message(wire) == payload
+    assert len(message_id(payload)) == 20
+    assert message_id(payload) != message_id(payload + b"x")
+
+
+def test_three_node_convergence_and_verify():
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        spec = get_spec("phase0", "minimal")
+        state = _cached_genesis(spec, default_balances, lambda s: s.MAX_EFFECTIVE_BALANCE)
+        # distinct participant subsets make the 6 payloads distinct even with
+        # stub signatures
+        atts = [
+            get_valid_attestation(
+                spec, state, index=spec.CommitteeIndex(i % 2), signed=True,
+                filter_participant_set=lambda c, k=i: set(sorted(c)[: 1 + k // 2]))
+            for i in range(6)
+        ]
+        payloads = [bytes(serialize(a)) for a in atts]
+
+        n = 3
+        ports = [BASE_PORT + i for i in range(n)]
+        nodes = [
+            GossipNode(i, ports[i], [p for j, p in enumerate(ports) if j != i])
+            for i in range(n)
+        ]
+        try:
+            connect_full_mesh(nodes)
+            # each node produces a disjoint share and floods it
+            shares = [payloads[0:2], payloads[2:4], payloads[4:6]]
+            threads = [
+                threading.Thread(target=nodes[i].publish, args=(shares[i],))
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # wait for flood delivery
+            import time
+
+            deadline = time.time() + 10
+            while time.time() < deadline and not all(
+                len(node.stats.message_ids) == len(payloads) for node in nodes
+            ):
+                time.sleep(0.05)
+
+            ids = [frozenset(node.stats.message_ids) for node in nodes]
+            assert ids[0] == ids[1] == ids[2], "nodes did not converge"
+            assert len(ids[0]) == len(payloads)
+
+            # re-flood a duplicate: dedup must absorb it
+            nodes[0].publish(shares[0][:1])
+            time.sleep(0.3)
+            assert any(node.stats.duplicates > 0 for node in nodes[1:])
+
+            # batch-verify each node's collected messages via the deferred path
+            def verify(ssz_bytes):
+                att = spec.Attestation.decode_bytes(ssz_bytes)
+                indexed = spec.get_indexed_attestation(state, att)
+                assert spec.is_valid_indexed_attestation(state, indexed)
+
+            for node in nodes:
+                assert node.drain_and_verify(verify) >= len(shares[0])
+                assert node.stats.verified_batches == 1
+        finally:
+            for node in nodes:
+                node.close()
+    finally:
+        bls.bls_active = prev
