@@ -42,6 +42,9 @@ REFERENCE_SPECS = Path("/root/reference/specs")
 # protocol (beacon-chain is the whole state transition).
 REFERENCE_DOCS = {
     "phase0": ["phase0/beacon-chain.md"],
+    # overlay order mirrors the compiler: altair's functions supersede
+    # phase0's where redefined
+    "altair": ["phase0/beacon-chain.md", "altair/beacon-chain.md", "altair/bls.md"],
 }
 
 

@@ -132,3 +132,51 @@ def test_full_epoch_transition_matches_reference(spec, ref):
     spec.process_slots(a, a.slot + slots_to_boundary)
     ref.process_slots(b, b.slot + slots_to_boundary)
     assert hash_tree_root(a) == hash_tree_root(b)
+
+
+# --- altair overlay ---------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_altair():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(scope="module")
+def ref_altair(spec_altair):
+    return build_reference_semantics("altair", "minimal")
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_altair_epoch_matches_reference(spec_altair, ref_altair, seed):
+    spec = spec_altair
+    base = _mid_life_state(spec, seed)
+    slots_to_boundary = spec.SLOTS_PER_EPOCH - (base.slot % spec.SLOTS_PER_EPOCH)
+    a, b = base.copy(), base.copy()
+    spec.process_slots(a, a.slot + slots_to_boundary)
+    ref_altair.process_slots(b, b.slot + slots_to_boundary)
+    assert hash_tree_root(a) == hash_tree_root(b)
+
+
+def test_altair_sync_aggregate_matches_reference(spec_altair, ref_altair):
+    from consensus_specs_tpu.testlib.sync_committee import build_sync_aggregate
+
+    spec = spec_altair
+    base = _genesis(spec)
+    next_slots(spec, base, 1)
+    aggregate = build_sync_aggregate(spec, base, [True] * int(spec.SYNC_COMMITTEE_SIZE))
+    a, b = base.copy(), base.copy()
+    spec.process_sync_aggregate(a, aggregate)
+    ref_altair.process_sync_aggregate(b, aggregate)
+    assert hash_tree_root(a) == hash_tree_root(b)
+
+
+def test_altair_block_transition_matches_reference(spec_altair, ref_altair):
+    spec = spec_altair
+    base = _genesis(spec)
+    tmp = base.copy()
+    block = build_empty_block_for_next_slot(spec, tmp)
+    signed = state_transition_and_sign_block(spec, tmp, block)
+    a, b = base.copy(), base.copy()
+    spec.state_transition(a, signed)
+    ref_altair.state_transition(b, signed)
+    assert hash_tree_root(a) == hash_tree_root(b)
