@@ -9,7 +9,11 @@ test/altair/transition/test_transition.py via with_fork_metas
 config-overridden spec build (compiler build_spec(config_overrides=...)).
 """
 from ..compiler import build_spec
-from ..testlib.block import build_empty_block_for_next_slot, state_transition_and_sign_block
+from ..testlib.block import (
+    apply_randao_reveal,
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
 from ..testlib.context import ALTAIR, BELLATRIX, PHASE0, spec_test, with_phases
 from ..testlib.genesis import create_valid_beacon_state
 
@@ -83,3 +87,160 @@ def test_transition_to_altair_with_blocks(spec, state=None, phases=None):
 def test_transition_to_bellatrix_with_blocks(spec, state=None, phases=None):
     pre, post = _overridden_specs(ALTAIR, BELLATRIX, spec.preset_name)
     yield from _run_transition(pre, post, BELLATRIX, blocks_before=2, blocks_after=2)
+
+
+# --- breadth: operations, skips, and continuity across the boundary ---------
+
+from ..ssz import hash_tree_root  # noqa: E402
+from ..testlib.attestations import get_valid_attestation  # noqa: E402
+from ..testlib.slashings import build_proposer_slashing  # noqa: E402
+from ..testlib.state import next_slots  # noqa: E402
+
+
+def _to_boundary_and_upgrade(spec, post_spec, post_fork, state):
+    fork_slot = FORK_EPOCH * int(spec.SLOTS_PER_EPOCH)
+    if int(state.slot) < fork_slot:
+        spec.process_slots(state, spec.Slot(fork_slot))
+    return getattr(post_spec, _UPGRADE_FN[post_fork])(state)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_attestation_from_previous_fork(spec, state=None, phases=None):
+    """An attestation made under phase0 rules in the last pre-fork epoch is
+    included POST-fork: altair must translate it into participation flags."""
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    # walk into the last pre-fork epoch and attest under the OLD rules
+    next_slots(pre, state, (FORK_EPOCH - 1) * int(pre.SLOTS_PER_EPOCH) + 2)
+    attestation = get_valid_attestation(pre, state, signed=True)
+    state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
+    block = build_empty_block_for_next_slot(post, state)
+    block.body.attestations.append(attestation)
+    apply_randao_reveal(post, state, block)
+    signed = state_transition_and_sign_block(post, state, block)
+    yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 1}
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert any(int(f) != 0 for f in state.previous_epoch_participation)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_deep_skip_across_boundary(spec, state=None, phases=None):
+    """An empty-slot gap spanning the fork: the first post-fork block lands
+    epochs after the last pre-fork one."""
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(pre, state)
+    signed_pre = state_transition_and_sign_block(pre, state, block)
+    state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
+    # skip a further full epoch post-fork before proposing
+    post.process_slots(state, state.slot + post.SLOTS_PER_EPOCH)
+    block = build_empty_block_for_next_slot(post, state)
+    signed_post = state_transition_and_sign_block(post, state, block)
+    yield "meta", "meta", {
+        "post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "fork_block": 0, "blocks_count": 2}
+    yield "blocks_0", signed_pre
+    yield "blocks_1", signed_post
+    yield "post", state.copy()
+    assert int(state.slot) >= (FORK_EPOCH + 1) * int(post.SLOTS_PER_EPOCH)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_slashing_survives_boundary(spec, state=None, phases=None):
+    """Both slashing interactions with the fork: a validator slashed
+    PRE-fork keeps its slashed flag through the upgrade, and a slashing
+    evidence signed pre-fork still processes post-fork."""
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    # slash validator A before the fork
+    slashing_a = build_proposer_slashing(pre, state, signed=True)
+    index_a = int(slashing_a.signed_header_1.message.proposer_index)
+    pre.process_proposer_slashing(state, slashing_a)
+    assert state.validators[index_a].slashed
+    # build (but do not process) evidence against a different validator B
+    index_b = (index_a + 1) % len(state.validators)
+    slashing_b = build_proposer_slashing(pre, state, proposer_index=index_b, signed=True)
+    state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
+    assert state.validators[index_a].slashed, "slashed flag lost in upgrade"
+    block = build_empty_block_for_next_slot(post, state)
+    block.body.proposer_slashings.append(slashing_b)
+    apply_randao_reveal(post, state, block)
+    signed = state_transition_and_sign_block(post, state, block)
+    yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 1}
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert state.validators[index_b].slashed
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_registry_invariants(spec, state=None, phases=None):
+    """The upgrade preserves every registry field and installs non-trivial
+    sync committees + zeroed inactivity scores (upgrade_to_altair contract)."""
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    # snapshot at the boundary, AFTER pre-fork epoch processing (penalties
+    # for empty participation) but BEFORE the upgrade itself
+    pre.process_slots(state, pre.Slot(FORK_EPOCH * int(pre.SLOTS_PER_EPOCH)))
+    pre_validators_root = hash_tree_root(state.validators)
+    pre_balances = [int(b) for b in state.balances]
+    state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
+    yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 0}
+    yield "post", state.copy()
+    assert hash_tree_root(state.validators) == pre_validators_root
+    assert [int(b) for b in state.balances] == pre_balances
+    assert all(int(x) == 0 for x in state.inactivity_scores)
+    assert len(state.inactivity_scores) == len(state.validators)
+    assert state.current_sync_committee == state.next_sync_committee
+    assert bytes(state.current_sync_committee.aggregate_pubkey) != b"\x00" * 48
+    assert bytes(state.fork.previous_version) == bytes(pre.config.GENESIS_FORK_VERSION)
+    assert bytes(state.fork.current_version) == bytes(post.config.ALTAIR_FORK_VERSION)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_transition_to_bellatrix_execution_header_default(spec, state=None, phases=None):
+    """upgrade_to_bellatrix installs the empty execution payload header: the
+    chain is pre-merge immediately after the fork."""
+    pre, post = _overridden_specs(ALTAIR, BELLATRIX, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    state = _to_boundary_and_upgrade(pre, post, BELLATRIX, state)
+    yield "meta", "meta", {"post_fork": BELLATRIX, "fork_epoch": FORK_EPOCH, "blocks_count": 0}
+    yield "post", state.copy()
+    assert not post.is_merge_transition_complete(state)
+    assert state.latest_execution_payload_header == post.ExecutionPayloadHeader()
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_transition_finality_continues_post_fork(spec, state=None, phases=None):
+    """Justification bits / checkpoints carried through the fork keep
+    advancing finality under the post-fork rules."""
+    from ..testlib.attestations import next_epoch_with_attestations
+
+    pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
+    state = create_valid_beacon_state(pre)
+    yield "pre", state.copy()
+    blocks = []
+    _, bs, state = next_epoch_with_attestations(pre, state, True, False)
+    blocks.extend(bs)
+    n_pre = len(blocks)
+    state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
+    for _ in range(3):
+        _, bs, state = next_epoch_with_attestations(post, state, True, True)
+        blocks.extend(bs)
+    yield "meta", "meta", {
+        "post_fork": ALTAIR, "fork_epoch": FORK_EPOCH,
+        "fork_block": n_pre - 1, "blocks_count": len(blocks)}
+    for i, b in enumerate(blocks):
+        yield f"blocks_{i}", b
+    yield "post", state.copy()
+    assert int(state.finalized_checkpoint.epoch) >= FORK_EPOCH

@@ -40,8 +40,37 @@ def create_genesis_state(spec, validator_balances, activation_threshold=None):
         activation_threshold = spec.MAX_EFFECTIVE_BALANCE
     deposit_root = b"\x42" * 32
     eth1_block_hash = b"\xda" * 32
+    # the state's Fork must carry the real per-fork versions: every signing
+    # domain derives from it (reference helpers/genesis.py:26-41 sets the
+    # same pairs; a zeroed Fork self-verifies but diverges from reference
+    # genesis states and breaks cross-fork upgrade invariants)
+    from ..compiler.spec_compiler import PREVIOUS_FORK
+
+    def fork_version(fork_name):
+        # convention: <FORK>_FORK_VERSION config key; phase0 = GENESIS
+        if fork_name is None or fork_name == "phase0":
+            return spec.config.GENESIS_FORK_VERSION
+        return getattr(spec.config, f"{fork_name.upper()}_FORK_VERSION", None)
+
+    current = fork_version(spec.fork)
+    previous = fork_version(PREVIOUS_FORK.get(spec.fork))
+    if current is None:
+        # fork without a configured version (sharding-era R&D): keep the
+        # pair COHERENT by walking back to the newest configured ancestor
+        walk = spec.fork
+        while current is None and walk is not None:
+            walk = PREVIOUS_FORK.get(walk)
+            current = fork_version(walk)
+        previous = fork_version(PREVIOUS_FORK.get(walk)) or current
+    elif previous is None:
+        previous = current
     state = spec.BeaconState(
         genesis_time=spec.config.MIN_GENESIS_TIME,
+        fork=spec.Fork(
+            previous_version=previous,
+            current_version=current,
+            epoch=spec.GENESIS_EPOCH,
+        ),
         eth1_deposit_index=len(validator_balances),
         eth1_data=spec.Eth1Data(
             deposit_root=deposit_root,
