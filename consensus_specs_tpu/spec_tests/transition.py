@@ -9,8 +9,11 @@ test/altair/transition/test_transition.py via with_fork_metas
 config-overridden spec build (compiler build_spec(config_overrides=...)).
 """
 from ..compiler import build_spec
+from ..ssz import hash_tree_root
+from ..testlib.attestations import get_valid_attestation
+from ..testlib.slashings import build_proposer_slashing
+from ..testlib.state import next_slots
 from ..testlib.block import (
-    apply_randao_reveal,
     build_empty_block_for_next_slot,
     state_transition_and_sign_block,
 )
@@ -30,6 +33,14 @@ def _overridden_specs(pre_fork, post_fork, preset):
     )
 
 
+def _to_boundary_and_upgrade(spec, post_spec, post_fork, state):
+    """Advance (if needed) to the fork slot with the pre-fork spec, upgrade."""
+    fork_slot = FORK_EPOCH * int(spec.SLOTS_PER_EPOCH)
+    if int(state.slot) < fork_slot:
+        spec.process_slots(state, spec.Slot(fork_slot))
+    return getattr(post_spec, _UPGRADE_FN[post_fork])(state)
+
+
 def _run_transition(spec, post_spec, post_fork, blocks_before=1, blocks_after=1):
     state = create_valid_beacon_state(spec)
     yield "pre", state.copy()
@@ -43,9 +54,7 @@ def _run_transition(spec, post_spec, post_fork, blocks_before=1, blocks_after=1)
         blocks.append(state_transition_and_sign_block(spec, state, block))
     fork_block_index = len(blocks) - 1 if blocks else None
 
-    # advance to the boundary with the pre-fork spec, then upgrade
-    spec.process_slots(state, spec.Slot(fork_slot))
-    state = getattr(post_spec, _UPGRADE_FN[post_fork])(state)
+    state = _to_boundary_and_upgrade(spec, post_spec, post_fork, state)
     assert state.fork.current_version == getattr(
         post_spec.config, f"{post_fork.upper()}_FORK_VERSION"
     )
@@ -91,18 +100,6 @@ def test_transition_to_bellatrix_with_blocks(spec, state=None, phases=None):
 
 # --- breadth: operations, skips, and continuity across the boundary ---------
 
-from ..ssz import hash_tree_root  # noqa: E402
-from ..testlib.attestations import get_valid_attestation  # noqa: E402
-from ..testlib.slashings import build_proposer_slashing  # noqa: E402
-from ..testlib.state import next_slots  # noqa: E402
-
-
-def _to_boundary_and_upgrade(spec, post_spec, post_fork, state):
-    fork_slot = FORK_EPOCH * int(spec.SLOTS_PER_EPOCH)
-    if int(state.slot) < fork_slot:
-        spec.process_slots(state, spec.Slot(fork_slot))
-    return getattr(post_spec, _UPGRADE_FN[post_fork])(state)
-
 
 @with_phases([PHASE0], other_phases=[ALTAIR])
 @spec_test
@@ -118,7 +115,6 @@ def test_transition_attestation_from_previous_fork(spec, state=None, phases=None
     state = _to_boundary_and_upgrade(pre, post, ALTAIR, state)
     block = build_empty_block_for_next_slot(post, state)
     block.body.attestations.append(attestation)
-    apply_randao_reveal(post, state, block)
     signed = state_transition_and_sign_block(post, state, block)
     yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 1}
     yield "blocks_0", signed
@@ -170,7 +166,6 @@ def test_transition_slashing_survives_boundary(spec, state=None, phases=None):
     assert state.validators[index_a].slashed, "slashed flag lost in upgrade"
     block = build_empty_block_for_next_slot(post, state)
     block.body.proposer_slashings.append(slashing_b)
-    apply_randao_reveal(post, state, block)
     signed = state_transition_and_sign_block(post, state, block)
     yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 1}
     yield "blocks_0", signed

@@ -1,0 +1,180 @@
+"""Pure-function unit checks across the fork matrix.
+
+Reference parity: test/phase0/unittests/ (validator unittests 478 LoC,
+helper/predicate unittests) — the layer below block/epoch processing: no
+vectors, just invariants of the spec's helper functions on live states.
+"""
+from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.state import next_epoch, next_slots
+
+
+@with_all_phases
+@spec_state_test
+def test_integer_squareroot_matches_math(spec, state):
+    import math
+
+    for x in (0, 1, 2, 3, 4, 15, 16, 17, 255, 256, 1 << 20, (1 << 32) - 1, 1 << 52):
+        assert int(spec.integer_squareroot(spec.uint64(x))) == math.isqrt(x)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_shuffled_index_is_permutation(spec, state):
+    seed = spec.hash(b"unittest seed")
+    n = 64
+    out = {int(spec.compute_shuffled_index(spec.uint64(i), spec.uint64(n), seed)) for i in range(n)}
+    assert out == set(range(n))
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_proposer_index_is_active_validator(spec, state):
+    indices = spec.get_active_validator_indices(state, spec.get_current_epoch(state))
+    seed = spec.get_seed(state, spec.get_current_epoch(state), spec.DOMAIN_BEACON_PROPOSER)
+    proposer = spec.compute_proposer_index(state, indices, seed)
+    assert proposer in indices
+
+
+@with_all_phases
+@spec_state_test
+def test_beacon_committees_partition_active_set(spec, state):
+    """Every active validator sits in exactly one committee per slot-window
+    epoch-wide (committees partition the shuffled active set)."""
+    epoch = spec.get_current_epoch(state)
+    seen = []
+    for slot_offset in range(int(spec.SLOTS_PER_EPOCH)):
+        slot = spec.Slot(int(spec.compute_start_slot_at_epoch(epoch)) + slot_offset)
+        for index in range(int(spec.get_committee_count_per_slot(state, epoch))):
+            seen.extend(
+                int(v) for v in spec.get_beacon_committee(state, slot, spec.CommitteeIndex(index))
+            )
+    active = {int(v) for v in spec.get_active_validator_indices(state, epoch)}
+    assert len(seen) == len(active)
+    assert set(seen) == active
+
+
+@with_all_phases
+@spec_state_test
+def test_get_total_balance_floors_at_increment(spec, state):
+    assert int(spec.get_total_balance(state, set())) == int(spec.EFFECTIVE_BALANCE_INCREMENT)
+
+
+@with_all_phases
+@spec_state_test
+def test_is_slashable_validator_windows(spec, state):
+    v = state.validators[0].copy()
+    epoch = spec.get_current_epoch(state)
+    assert spec.is_slashable_validator(v, epoch)
+    v.slashed = True
+    assert not spec.is_slashable_validator(v, epoch)
+    v.slashed = False
+    v.withdrawable_epoch = epoch  # already withdrawable: no longer slashable
+    assert not spec.is_slashable_validator(v, epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_is_slashable_attestation_data_rules(spec, state):
+    mk = lambda src, tgt, root: spec.AttestationData(  # noqa: E731
+        source=spec.Checkpoint(epoch=src), target=spec.Checkpoint(epoch=tgt),
+        beacon_block_root=root)
+    a = mk(0, 2, b"\x01" * 32)
+    # double vote: same target epoch, different data
+    assert spec.is_slashable_attestation_data(a, mk(0, 2, b"\x02" * 32))
+    # surround vote
+    assert spec.is_slashable_attestation_data(mk(0, 3, b"\x01" * 32), mk(1, 2, b"\x01" * 32))
+    # identical data is NOT slashable; disjoint epochs are not either
+    assert not spec.is_slashable_attestation_data(a, a)
+    assert not spec.is_slashable_attestation_data(a, mk(2, 3, b"\x01" * 32))
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_fork_digest_changes_with_version(spec, state):
+    d1 = spec.compute_fork_digest(spec.Version(b"\x00\x00\x00\x01"), spec.Root(b"\x00" * 32))
+    d2 = spec.compute_fork_digest(spec.Version(b"\x00\x00\x00\x02"), spec.Root(b"\x00" * 32))
+    d3 = spec.compute_fork_digest(spec.Version(b"\x00\x00\x00\x01"), spec.Root(b"\x01" * 32))
+    assert len(bytes(d1)) == 4 and d1 != d2 and d1 != d3
+
+
+@with_all_phases
+@spec_state_test
+def test_get_block_root_windows(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 2)
+    prev = spec.get_previous_epoch(state)
+    root = spec.get_block_root(state, prev)
+    assert root == spec.get_block_root_at_slot(state, spec.compute_start_slot_at_epoch(prev))
+
+
+@with_all_phases
+@spec_state_test
+def test_churn_limit_floor(spec, state):
+    assert int(spec.get_validator_churn_limit(state)) == max(
+        int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
+        len(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+        // int(spec.config.CHURN_LIMIT_QUOTIENT),
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_committee_assignment_consistency(spec, state):
+    """get_committee_assignment (validator guide) agrees with the committee
+    it claims: the validator really is in that committee at that slot."""
+    next_epoch(spec, state)
+    epoch = spec.get_current_epoch(state)
+    found = 0
+    for index in range(min(8, len(state.validators))):
+        assignment = spec.get_committee_assignment(state, epoch, spec.ValidatorIndex(index))
+        if assignment is None:
+            continue
+        committee, committee_index, slot = assignment
+        assert index in [int(v) for v in committee]
+        assert committee == spec.get_beacon_committee(state, slot, committee_index)
+        found += 1
+    assert found > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_is_aggregator_threshold_floor(spec, state):
+    """Committees smaller than TARGET_AGGREGATORS_PER_COMMITTEE make every
+    member an aggregator (the max(1, ...) modulo floor)."""
+    slot = state.slot
+    committee = spec.get_beacon_committee(state, slot, spec.CommitteeIndex(0))
+    if len(committee) <= int(spec.TARGET_AGGREGATORS_PER_COMMITTEE):
+        for probe in range(4):
+            sig = spec.BLSSignature(bytes([probe + 1]) + b"\x00" * 95)
+            assert spec.is_aggregator(state, slot, spec.CommitteeIndex(0), sig)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_indexed_attestation_sorted_and_valid(spec, state):
+    from ..testlib.attestations import get_valid_attestation
+
+    attestation = get_valid_attestation(spec, state, signed=True)
+    indexed = spec.get_indexed_attestation(state, attestation)
+    idx = [int(i) for i in indexed.attesting_indices]
+    assert idx == sorted(idx) and len(set(idx)) == len(idx)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert spec.is_valid_indexed_attestation(state, indexed)
+
+
+@with_all_phases
+@spec_state_test
+def test_weak_subjectivity_period_grows_with_balance(spec, state):
+    if not hasattr(spec, "compute_weak_subjectivity_period"):
+        return
+    base = int(spec.compute_weak_subjectivity_period(state))
+    assert base >= int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_period_boundary(spec, state):
+    period_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    assert int(spec.compute_time_at_slot(state, state.slot)) == int(state.genesis_time) + \
+        int(state.slot) * int(spec.config.SECONDS_PER_SLOT)
+    votes_len_bound = int(type(state.eth1_data_votes).LIMIT)
+    assert votes_len_bound == period_slots

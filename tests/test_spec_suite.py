@@ -22,3 +22,4 @@ from consensus_specs_tpu.spec_tests.epoch_processing import *  # noqa: E402,F401
 from consensus_specs_tpu.spec_tests.operations import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.sanity_blocks import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.sync_aggregate import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.unittests import *  # noqa: E402,F401,F403
