@@ -8,7 +8,7 @@ PYTHON ?= python
 TEST_VECTOR_DIR ?= ../consensus-spec-tests/tests
 GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
-             merkle random
+             merkle random custody_sharding
 
 .PHONY: test citest testfast lint pyspec generate_tests clean_vectors \
         detect_generator_incomplete bench graft_check native replay \

@@ -9,7 +9,8 @@ which (a) round-trip-validates our generators and (b) replays externally
 produced consensus-spec-tests corpora against the TPU spec.
 
 Supported runners: operations, epoch_processing, sanity, finality, random,
-forks, transition, genesis, shuffling, ssz_static, merkle, fork_choice.
+forks, transition, genesis, shuffling, ssz_static, merkle, fork_choice,
+custody_sharding (beyond the reference's surface).
 Unknown runners are reported as skipped, never silently dropped.
 """
 from __future__ import annotations
@@ -115,6 +116,61 @@ def _replay_operations(spec, case_dir, meta):
         )
     typ, process = table[op_name]
     operation = _read_ssz(case_dir, op_name, typ)
+    expect_valid = _has(case_dir, "post")
+    try:
+        process(state, operation)
+    except (AssertionError, IndexError):
+        assert not expect_valid, "operation rejected but vector has a post state"
+        return
+    assert expect_valid, "operation accepted but vector has no post state"
+    post = _read_ssz(case_dir, "post", spec.BeaconState)
+    assert spec.hash_tree_root(state) == spec.hash_tree_root(post), "post state mismatch"
+
+
+def _replay_custody_sharding(spec, case_dir, meta):
+    """Custody-game / shard-ops cases (capability beyond the reference, which
+    disables sharding-era testgen). Two shapes: epoch-style (sub_transition
+    meta names the process_* sweep) and operation-style (one op file).
+
+    Vectors for these forks are generated against the deterministic
+    insecure_test_setup(16) (generators/custody_sharding installs it);
+    replay installs the same setup so live-crypto degree-bound pairings
+    reproduce."""
+    from ..crypto import kzg, kzg_shim
+
+    if kzg_shim._setup is None:
+        kzg_shim.use_setup(kzg.insecure_test_setup(16))
+    sub = (meta or {}).get("sub_transition")
+    if sub is not None:
+        state = _read_ssz(case_dir, "pre", spec.BeaconState)
+        fn = getattr(spec, f"process_{sub}", None) or getattr(spec, sub)
+        fn(state)
+        post = _read_ssz(case_dir, "post", spec.BeaconState)
+        assert spec.hash_tree_root(state) == spec.hash_tree_root(post), "post mismatch"
+        return
+    table = {
+        "custody_key_reveal": (spec.CustodyKeyReveal, spec.process_custody_key_reveal),
+        "early_derived_secret_reveal": (
+            spec.EarlyDerivedSecretReveal, spec.process_early_derived_secret_reveal),
+        "chunk_challenge": (spec.CustodyChunkChallenge, spec.process_chunk_challenge),
+        "chunk_challenge_response": (
+            spec.CustodyChunkResponse, spec.process_chunk_challenge_response),
+        "custody_slashing": (spec.SignedCustodySlashing, spec.process_custody_slashing),
+        "shard_header": (spec.SignedShardBlobHeader, spec.process_shard_header),
+        "attestation": (spec.Attestation, spec.process_attested_shard_work),
+    } if hasattr(spec, "CustodyKeyReveal") else {
+        "shard_header": (spec.SignedShardBlobHeader, spec.process_shard_header),
+        "attestation": (spec.Attestation, spec.process_attested_shard_work),
+    }
+    state = _read_ssz(case_dir, "pre", spec.BeaconState)
+    op_files = [
+        q.name.removesuffix(".ssz_snappy")
+        for q in case_dir.glob("*.ssz_snappy")
+        if q.name.removesuffix(".ssz_snappy") not in ("pre", "post")
+    ]
+    assert len(op_files) == 1, f"expected one operation file, got {op_files}"
+    typ, process = table[op_files[0]]
+    operation = _read_ssz(case_dir, op_files[0], typ)
     expect_valid = _has(case_dir, "post")
     try:
         process(state, operation)
@@ -486,6 +542,8 @@ def replay_case(case_dir: Path, preset: str, fork: str, runner: str, handler: st
             _replay_merkle(spec, case_dir)
         elif runner == "fork_choice":
             _replay_fork_choice(spec, case_dir, meta)
+        elif runner == "custody_sharding":
+            _replay_custody_sharding(spec, case_dir, meta)
         else:
             raise NotImplementedError(runner)
     finally:

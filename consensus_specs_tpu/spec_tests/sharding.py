@@ -257,6 +257,7 @@ def test_attested_shard_work_below_quorum_stays_pending(spec, state):
     attestation = _attest_to_header(spec, state, header_root, slot, fraction=(1, 2))
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
     yield "pre", state.copy()
+    yield "attestation", attestation
     spec.process_attested_shard_work(state, attestation)
     yield "post", state.copy()
     work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]
@@ -277,6 +278,7 @@ def test_attested_shard_work_empty_root_unconfirms(spec, state):
     attestation = _attest_to_header(spec, state, empty_root, slot)
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
     yield "pre", state.copy()
+    yield "attestation", attestation
     spec.process_attested_shard_work(state, attestation)
     yield "post", state.copy()
     work = state.shard_buffer[int(slot) % int(spec.SHARD_STATE_MEMORY_SLOTS)][int(shard)]

@@ -1,0 +1,30 @@
+"""Custody-game + sharding operation vector generator.
+
+BEYOND reference parity: the reference disables sharding-era testgen
+(tests/generators/operations/main.py:26-33 comments them out); this
+framework compiles those forks, so their suites emit replayable vectors
+like any other fork.
+Usage: python main.py -o <output_dir>
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.crypto import kzg, kzg_shim
+from consensus_specs_tpu.gen import run_state_test_generators
+
+from consensus_specs_tpu.spec_tests import custody_game, sharding
+
+# generator mode runs with LIVE crypto (the reference forces its fast
+# backend for all vector generation): the sharding/custody pairing checks
+# need the deterministic trusted setup installed
+kzg_shim.use_setup(kzg.insecure_test_setup(16))
+
+ALL_MODS = {
+    "custody_game": {"custody": custody_game},
+    "sharding": {"shard_ops": sharding},
+}
+
+if __name__ == "__main__":
+    run_state_test_generators("custody_sharding", ALL_MODS, presets=("minimal",))

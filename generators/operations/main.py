@@ -11,11 +11,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
 from consensus_specs_tpu.gen import run_state_test_generators
 
 from consensus_specs_tpu.spec_tests import operations as ops
+from consensus_specs_tpu.spec_tests import sync_aggregate
 
 ALL_MODS = {
     "phase0": {"operations": ops},
-    "altair": {"operations": ops},
-    "bellatrix": {"operations": ops},
+    "altair": {"operations": ops, "sync_aggregate": sync_aggregate},
+    "bellatrix": {"operations": ops, "sync_aggregate": sync_aggregate},
 }
 
 if __name__ == "__main__":

@@ -97,3 +97,17 @@ def test_replay_detects_corruption(tmp_path):
     posts[0].write_bytes(pres.read_bytes())
     summary = replay_tree(tmp_path)
     assert summary.failed, "corrupted vector not detected"
+
+
+def test_roundtrip_custody_sharding(tmp_path):
+    """The beyond-reference forks round-trip too (BLS stubbed; the
+    live-crypto pairing cases are exercised by generators/custody_sharding
+    and the always_bls pytest suites)."""
+    from consensus_specs_tpu.spec_tests import custody_game, sharding
+
+    n = _generate(tmp_path, "custody_sharding", "custody", custody_game,
+                  fork="custody_game")
+    n += _generate(tmp_path, "custody_sharding", "shard_ops", sharding,
+                   fork="sharding")
+    summary = replay_tree(tmp_path)
+    _assert_clean(summary, n)
