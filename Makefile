@@ -77,8 +77,7 @@ bench:
 # jax_platforms), so env vars alone don't stick — force the CPU mesh the way
 # tests/conftest.py does.
 graft_check:
-	XLA_FLAGS=--xla_force_host_platform_device_count=8 $(PYTHON) -c "\
-	import jax; jax.config.update('jax_platforms', 'cpu'); \
-	from jax._src import xla_bridge as xb; xb._backend_factories.pop('axon', None); \
+	$(PYTHON) -c "\
+	from consensus_specs_tpu.utils.backend import force_cpu; force_cpu(8); \
 	import __graft_entry__ as g; fn, args = g.entry(); fn(*args); \
 	g.dryrun_multichip(8); print('graft entry ok')"

@@ -15,12 +15,23 @@ hash-to-curve) is excluded from the BLS timed region: pubkeys live
 decompressed in the registry and messages hash once per slot, so the pairing
 is the marginal per-verification cost.
 
-Prints exactly one JSON line on stdout (progress notes on stderr).
+Prints exactly ONE JSON line on stdout (progress notes on stderr) — even on
+failure. Scoreboard robustness (VERDICT r2 item 1): the accelerator backend is
+probed in a SUBPROCESS with a hard timeout before the main process ever
+touches it, because a broken TPU tunnel makes `jax.devices()` block for
+minutes. On an unavailable/hung backend the script falls back to a
+clearly-labeled small-shape CPU-debug run and emits
+`{"error": "tpu_unavailable", ...}` alongside those numbers instead of a raw
+traceback. Every successful measurement is also persisted to
+BENCH_LOCAL.json (timestamp + git SHA) so perf evidence survives tunnel
+outages. Crash-forensics stance modeled on the reference generator runtime
+(gen_base/gen_runner.py error-log + INCOMPLETE sentinels).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -28,6 +39,41 @@ N_VALIDATORS = int(os.environ.get("BENCH_VALIDATORS", 1_048_576))
 N_BLS = int(os.environ.get("BENCH_BLS_N", 2048))
 BLS_TARGET = 100_000.0
 EPOCH_TARGET_S = 2.0
+BACKEND_PROBE_TIMEOUT_S = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", 120))
+# small shapes for the cpu-debug fallback lane (tpu unavailable)
+CPU_DEBUG_VALIDATORS = int(os.environ.get("BENCH_CPU_VALIDATORS", 65_536))
+CPU_DEBUG_BLS = int(os.environ.get("BENCH_CPU_BLS_N", 128))
+
+
+def probe_accelerator() -> str | None:
+    """Return the accelerator platform name, or None if unavailable/hung.
+
+    Runs `jax.devices()` in a child process under a hard timeout — the only
+    safe way to ask "is the tunnel up" without risking a multi-minute block
+    in the process that must emit the scoreboard line."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=BACKEND_PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"# backend probe timed out after {BACKEND_PROBE_TIMEOUT_S:.0f}s",
+              file=sys.stderr)
+        return None
+    if res.returncode != 0:
+        tail = (res.stderr or "").strip().splitlines()[-1:] or ["?"]
+        print(f"# backend probe failed: {tail[0]}", file=sys.stderr)
+        return None
+    platform = res.stdout.strip()
+    return platform or None
+
+
+def force_cpu() -> None:
+    """Pin this process to the host CPU backend before any backend init."""
+    from consensus_specs_tpu.utils.backend import force_cpu as _force_cpu
+
+    _force_cpu()
 
 
 def bench_epoch() -> float:
@@ -99,7 +145,7 @@ def bench_bls() -> tuple[float, float, float]:
     return per_item, N_BLS / min(rlc_times), compile_s
 
 
-def main() -> None:
+def run_benches() -> dict:
     import contextlib
 
     import jax
@@ -120,28 +166,93 @@ def main() -> None:
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": "bls_verify_throughput",
-                "value": round(vps, 1),
-                "unit": "verifications/sec/chip",
-                "vs_baseline": round(vps / BLS_TARGET, 4),
-                "extra": {
-                    "bls_batch": N_BLS,
-                    "bls_verify_throughput_rlc": round(rlc_vps, 1),
-                    "bls_compile_s": round(compile_s, 1),
-                    "process_epoch_1m_s": round(epoch_s, 4),
-                    "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
-                    "attestations_per_sec": round(att_per_s, 1),
-                    "attestation_epoch_s": round(att_epoch_s, 4),
-                    "attestations_per_epoch": att_count,
-                    "attestation_validators": att_bench.default_validators(),
-                    "device": str(jax.devices()[0]),
-                },
-            }
-        )
-    )
+    return {
+        "metric": "bls_verify_throughput",
+        "value": round(vps, 1),
+        "unit": "verifications/sec/chip",
+        "vs_baseline": round(vps / BLS_TARGET, 4),
+        "extra": {
+            "bls_batch": N_BLS,
+            "bls_verify_throughput_rlc": round(rlc_vps, 1),
+            "bls_compile_s": round(compile_s, 1),
+            "process_epoch_1m_s": round(epoch_s, 4),
+            "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
+            "attestations_per_sec": round(att_per_s, 1),
+            "attestation_epoch_s": round(att_epoch_s, 4),
+            "attestations_per_epoch": att_count,
+            "attestation_validators": att_bench.default_validators(),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def persist_local(record: dict) -> None:
+    """Append the measurement to BENCH_LOCAL.json so perf evidence survives a
+    tunnel outage (VERDICT r2: no persisted bench provenance)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LOCAL.json")
+    entry = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git_sha(),
+        **record,
+    }
+    try:
+        history = []
+        if os.path.exists(path):
+            with open(path) as f:
+                history = json.load(f)
+            if not isinstance(history, list):
+                history = [history]
+        history.append(entry)
+        with open(path, "w") as f:
+            json.dump(history, f, indent=1)
+    except Exception as exc:  # never let provenance writing kill the bench
+        print(f"# BENCH_LOCAL.json write failed: {exc}", file=sys.stderr)
+
+
+def main() -> None:
+    global N_VALIDATORS, N_BLS
+    record: dict
+    platform = probe_accelerator()
+    cpu_debug = platform is None or platform == "cpu"
+    if cpu_debug:
+        print("# accelerator unavailable — cpu-debug lane (small shapes)",
+              file=sys.stderr)
+        force_cpu()
+        N_VALIDATORS = min(N_VALIDATORS, CPU_DEBUG_VALIDATORS)
+        N_BLS = min(N_BLS, CPU_DEBUG_BLS)
+        os.environ.setdefault("BENCH_ATT_VALIDATORS", "8192")
+    try:
+        record = run_benches()
+        if cpu_debug:
+            record["error"] = "tpu_unavailable"
+            record["extra"]["mode"] = "cpu_debug_small_shapes"
+            record["vs_baseline"] = 0.0
+    except Exception as exc:  # scoreboard line must parse no matter what
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        record = {
+            "metric": "bls_verify_throughput",
+            "value": 0.0,
+            "unit": "verifications/sec/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}"[:500],
+        }
+    if "value" in record and record["value"] > 0:
+        # real measurements only (incl. labeled cpu-debug): crash records
+        # with value 0 carry no perf evidence worth committing
+        persist_local(record)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -59,31 +59,81 @@ class BLSVerificationError(AssertionError):
     inline `assert bls.Verify(...)` failure."""
 
 
-_deferred_queue = None  # None = inline mode; list = queueing
+import threading as _threading
+
+
+class _DeferralState(_threading.local):
+    """Per-thread deferral state: the gossip driver's threaded mode runs
+    concurrent drain_and_verify batches, and state_transition now enters the
+    context unconditionally — a shared global queue would interleave checks
+    across threads and misattribute failures."""
+
+    def __init__(self):
+        self.queue = None  # None = inline mode; list = queueing
+        self.depth = 0  # reentrancy: only the outermost context flushes
+
+
+_deferral = _DeferralState()
+flush_count = 0  # batched flushes performed (test observability: one/block)
+inline_check_count = 0  # un-batched verifications (should be ~0 in spec path)
 
 
 class deferred_verification:
-    """Context manager: queue all signature checks, verify once at exit."""
+    """Context manager: queue all signature checks, verify once at exit.
+
+    Reentrant: `state_transition` establishes this context by default, and an
+    outer caller (fork choice replaying many blocks, the gossip driver) may
+    hold its own — inner contexts then queue into the outer one and the single
+    flush happens at the outermost exit. An inner body that raises truncates
+    its own queued checks (the failed block's work is discarded wholesale)
+    without poisoning the outer batch."""
 
     def __enter__(self):
-        global _deferred_queue
-        if _deferred_queue is not None:  # not assert: -O must not skip this
-            raise RuntimeError("deferred_verification cannot nest")
-        _deferred_queue = []
+        _deferral.depth += 1
+        if _deferral.queue is None:
+            _deferral.queue = []
+        self._entry_len = len(_deferral.queue)
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        global _deferred_queue
-        queue, _deferred_queue = _deferred_queue, None
+        global flush_count
+        _deferral.depth -= 1
+        if exc_type is not None and _deferral.queue is not None:
+            # drop checks queued by the failed body: the caller discards that
+            # block's state, so its half-applied checks must not decide the
+            # fate of sibling blocks in an outer batch
+            del _deferral.queue[self._entry_len:]
+        if _deferral.depth > 0:
+            return False  # inner context: the outermost one flushes
+        queue, _deferral.queue = _deferral.queue, None
         if exc_type is not None:
             return False  # propagate; skip verification of a failed body
         if queue:
+            flush_count += 1
             results = _flush_deferred(queue)
             if not all(results):
                 bad = [i for i, ok in enumerate(results) if not ok]
                 raise BLSVerificationError(
                     f"deferred batch verification failed for checks {bad}"
                 )
+        return False
+
+
+class inline_verification:
+    """Context manager: bypass any active deferral for checks whose boolean
+    steers control flow rather than feeding an assert. The one spec consumer
+    is `process_deposit` — an invalid deposit signature skips the deposit
+    (the funds are burned) instead of failing the block, so its check must
+    resolve immediately; deferring it would turn a skippable deposit into a
+    whole-block rejection at flush time."""
+
+    def __enter__(self):
+        self._saved = _deferral.queue
+        _deferral.queue = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _deferral.queue = self._saved
         return False
 
 
@@ -115,9 +165,11 @@ def _flush_deferred(queue):
 def _check(kind, args, py_fn):
     """Common path for the three verification ops: queue when deferring,
     else dispatch to the active backend."""
-    if _deferred_queue is not None:
-        _deferred_queue.append((kind, args))
+    global inline_check_count
+    if _deferral.queue is not None:
+        _deferral.queue.append((kind, args))
         return True
+    inline_check_count += 1
     if _backend == "jax":
         return bool(_flush_deferred([(kind, args)])[0])
     return py_fn(*args)

@@ -4,8 +4,11 @@ The reference specifies its network layer as prose and never executes it
 (SURVEY.md §5: "distributed communication backend: none implemented"). This
 framework keeps the vectors-as-test-bus stance for conformance but ships the
 piece the reference leaves to clients: a host-side driver that plays the
-gossip layer's role for multi-host load runs. Each node is a separate OS
-process (one per host/slice in a real deployment) that:
+gossip layer's role for multi-host load runs. Each node owns a TCP listener
+socket and may run as a thread (how the in-repo tests drive it, all nodes in
+one process) or as its own OS process via `run_node_process`/`spawn_cluster`
+(one per host/slice in a real deployment; exercised by the
+`test_gossip_driver` process-cluster test). A node:
 
   1. produces its share of signed attestation messages for the slot,
   2. floods them to every peer over TCP (localhost stands in for DCN),
@@ -194,3 +197,87 @@ def connect_full_mesh(nodes: list[GossipNode]) -> None:
         node.dial_peers()
     for t in acceptors:
         t.join(timeout=15.0)
+
+
+# --- one-OS-process-per-node cluster -----------------------------------------
+
+
+def run_node_process(node_id: int, ports: list[int], messages_per_node: int,
+                     barrier, out_queue) -> None:
+    """Entry point for one cluster member running in its OWN OS process.
+
+    Wires into the full mesh (two barrier phases: listeners up, mesh dialed),
+    floods its share of deterministic payloads, waits for convergence, and
+    reports (node_id, message_count, duplicates, sha256-of-sorted-ids) so the
+    parent can assert every process converged to the identical message set."""
+    import time
+    import traceback
+
+    try:
+        n = len(ports)
+        peers = [p for i, p in enumerate(ports) if i != node_id]
+        node = GossipNode(node_id, ports[node_id], peers)
+        barrier.wait(timeout=30.0)  # every process has a listening socket
+        acceptor = threading.Thread(target=node.accept_peers, args=(n - 1,), daemon=True)
+        acceptor.start()
+        node.dial_peers()
+        acceptor.join(timeout=15.0)
+        barrier.wait(timeout=30.0)  # full mesh wired
+        payloads = [
+            b"node %03d attestation %06d " % (node_id, j) + b"." * 40
+            for j in range(messages_per_node)
+        ]
+        node.publish(payloads)
+        want = n * messages_per_node
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            with node._lock:
+                have = len(node.stats.message_ids)
+            if have >= want:
+                break
+            time.sleep(0.02)
+        with node._lock:
+            ids = sorted(node.stats.message_ids)
+            dups = node.stats.duplicates
+        digest = hashlib.sha256(b"".join(ids)).hexdigest()
+        out_queue.put((node_id, len(ids), dups, digest))
+        node.close()
+    except BaseException:  # always report: a silent child hangs the parent
+        out_queue.put((node_id, -1, -1, traceback.format_exc()))
+        raise
+
+
+def spawn_cluster(n_nodes: int, messages_per_node: int = 8,
+                  base_port: int | None = None) -> list[tuple]:
+    """Run one gossip round with one OS process per node (localhost TCP
+    standing in for DCN). Returns the per-node reports sorted by node id;
+    convergence holds iff every report carries the same count and digest."""
+    import multiprocessing as mp
+    import os
+
+    if base_port is None:
+        base_port = 20000 + (os.getpid() * 7) % 20000
+    ports = [base_port + i for i in range(n_nodes)]
+    ctx = mp.get_context("spawn")
+    barrier = ctx.Barrier(n_nodes)
+    out_queue = ctx.Queue()
+    procs = [
+        ctx.Process(target=run_node_process,
+                    args=(i, ports, messages_per_node, barrier, out_queue))
+        for i in range(n_nodes)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        reports = [out_queue.get(timeout=120.0) for _ in range(n_nodes)]
+    finally:
+        for p in procs:
+            p.join(timeout=30.0)
+            if p.is_alive():  # report collected or failed; never leak children
+                p.terminate()
+    failed = [r for r in reports if r[1] < 0]
+    if failed:
+        raise RuntimeError(
+            f"gossip cluster: {len(failed)} node(s) crashed:\n" +
+            "\n".join(r[3] for r in failed))
+    return sorted(reports)

@@ -1,0 +1,40 @@
+"""Backend pinning: force the host CPU platform before jax touches a device.
+
+One copy of the accelerator-avoidance dance used by every TPU-free entry
+point (tests/conftest.py, __graft_entry__.dryrun_multichip, bench.py's
+debug lane, `make graft_check`). The environment pins JAX_PLATFORMS=axon (a
+remote TPU tunnel) and its sitecustomize imports jax at interpreter start,
+so three things are needed, in order: override the env var (for child
+processes), drop the accelerator PJRT plugin factories (jax initializes
+every registered plugin even when not selected, and the tunnel blocks when
+another process holds the single TPU), and update jax_platforms (the env
+var was already frozen into jax.config at import).
+"""
+from __future__ import annotations
+
+ACCELERATOR_PLUGINS = ("axon", "tpu", "cuda", "rocm")
+
+
+def force_cpu(n_devices: int | None = None):
+    """Pin this process to the CPU backend; with `n_devices`, provision a
+    virtual multi-device CPU mesh (tearing down any already-initialized
+    backend — three caches must all clear or the old backend keeps being
+    served: _backends, get_backend's lru, and the plugin factory table).
+
+    Safe to call before OR after a backend exists; never probes an
+    accelerator. Returns the jax module."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    from jax._src import xla_bridge as xb
+
+    for plugin in ACCELERATOR_PLUGINS:
+        xb._backend_factories.pop(plugin, None)
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        if getattr(xb, "_backends", None):
+            xb._clear_backends()
+            xb.get_backend.cache_clear()
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    return jax

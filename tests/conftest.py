@@ -4,30 +4,16 @@ Multi-chip TPU hardware is not available in CI; sharded code paths
 (pjit/shard_map over a Mesh) are validated on 8 virtual CPU devices, mirroring
 how the driver's dryrun_multichip compile-checks the multi-chip path.
 
-The environment pins JAX_PLATFORMS=axon (a remote TPU tunnel) and its
-sitecustomize imports jax at interpreter start, so two overrides are needed
-here: the config update (the env var was already frozen into jax.config), and
-dropping the axon PJRT factory (jax initializes every registered plugin even
-when it is not selected, and the tunnel blocks when another process holds the
-single TPU — tests must never contend for it).
+The accelerator-avoidance dance (env override, plugin-factory drop, config
+update) lives in the shared helper consensus_specs_tpu.utils.backend.force_cpu
+— the same path __graft_entry__.dryrun_multichip and bench.py's debug lane
+use, so all TPU-free entry points pin the backend identically.
 """
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax
 import pytest
 
-jax.config.update("jax_platforms", "cpu")
-try:
-    from jax._src import xla_bridge as _xb
+from consensus_specs_tpu.utils.backend import force_cpu
 
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - jax internals moved; cpu select still set
-    pass
+force_cpu(8)
 
 
 # --- reference-parity CLI flags (test/conftest.py --preset/--fork/--bls-type)

@@ -139,6 +139,46 @@ def test_device_pubkey_aggregation_matches_oracle_pairing():
     assert got_inf == oracle.g1_to_bytes(None)
 
 
+def test_default_state_transition_one_launch_pairing(monkeypatch):
+    """With the jax backend and NO outer context, a full state_transition
+    performs its signature work in exactly ONE device pairing launch
+    (VERDICT r2 item 2's launch-count requirement)."""
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.ops import bls12_jax as K
+    from consensus_specs_tpu.testlib.block import (
+        build_empty_block_for_next_slot,
+        state_transition_and_sign_block,
+    )
+    from consensus_specs_tpu.testlib.context import _cached_genesis, default_balances
+
+    spec = get_spec("phase0", "minimal")
+    base = _cached_genesis(spec, default_balances, lambda s: s.MAX_EFFECTIVE_BALANCE)
+    bls.use_py()
+    tmp = base.copy()
+    block = build_empty_block_for_next_slot(spec, tmp)
+    signed = state_transition_and_sign_block(spec, tmp, block)
+
+    launches = {"n": 0}
+    real_batch, real_rlc = K.pairing_check_batch, K.pairing_check_rlc
+
+    def counting_batch(*args, **kw):
+        launches["n"] += 1
+        return real_batch(*args, **kw)
+
+    def counting_rlc(*args, **kw):
+        launches["n"] += 1
+        return real_rlc(*args, **kw)
+
+    monkeypatch.setattr(K, "pairing_check_batch", counting_batch)
+    monkeypatch.setattr(K, "pairing_check_rlc", counting_rlc)
+
+    bls.use_jax()
+    state = base.copy()
+    spec.state_transition(state, signed)  # no explicit context: the default
+    assert launches["n"] == 1, (
+        f"expected 1 device pairing launch per block, saw {launches['n']}")
+
+
 def test_deferred_large_batch_rlc_path_pairing():
     """A >=16-item deferred flush takes the shared-final-exp randomized path;
     a corrupted batch falls back to per-item attribution and still raises."""

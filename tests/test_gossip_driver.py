@@ -92,3 +92,17 @@ def test_three_node_convergence_and_verify():
                 node.close()
     finally:
         bls.bls_active = prev
+
+
+def test_process_cluster_convergence():
+    """One OS process per node (the deployment shape the docstring promises):
+    4 processes × 8 messages each, full mesh over localhost TCP; every
+    process must report the identical 32-message set."""
+    from consensus_specs_tpu.parallel.gossip_driver import spawn_cluster
+
+    reports = spawn_cluster(n_nodes=4, messages_per_node=8, base_port=BASE_PORT + 40)
+    assert [r[0] for r in reports] == [0, 1, 2, 3]
+    counts = {r[1] for r in reports}
+    digests = {r[3] for r in reports}
+    assert counts == {32}, f"non-converged counts: {sorted(r[:2] for r in reports)}"
+    assert len(digests) == 1, "processes hold different message sets"
