@@ -1,0 +1,93 @@
+"""BASELINE hard-part 2: per-slot state-root cost at registry scale.
+
+Measures `hash_tree_root(state)` on a mainnet-preset altair BeaconState:
+  - cold: first full Merkleization (tree build)
+  - slot: the process_slot write pattern (state_roots/block_roots rotation,
+    header update, slot bump) followed by a re-root — the incremental path
+  - block: a block-ish touch (proposer + 2048 attesters' participation
+    flags + a few balances) followed by a re-root
+
+Usage: python benches/state_root_bench.py [n_validators] — one JSON line.
+The driver-visible numbers ride in bench.py's `extra.state_root_*`.
+"""
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def default_validators() -> int:
+    return int(os.environ.get("BENCH_SR_VALIDATORS", 1_048_576))
+
+
+def run(n_validators: int | None = None):
+    """Returns dict of timings (seconds)."""
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.ssz import hash_tree_root
+    from consensus_specs_tpu.testlib.big_state import synthetic_beacon_state
+
+    if n_validators is None:
+        n_validators = default_validators()
+    spec = get_spec("altair", "mainnet")
+
+    t0 = time.time()
+    state = synthetic_beacon_state(spec, n_validators)
+    build_s = time.time() - t0
+    print(f"# state build ({n_validators} validators): {build_s:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    root = hash_tree_root(state)
+    cold_s = time.time() - t0
+    print(f"# cold full root: {cold_s:.2f}s", file=sys.stderr)
+
+    # per-slot pattern (process_slot: cache state root, header root, slot bump)
+    slot_times = []
+    for k in range(5):
+        slot = int(state.slot)
+        t0 = time.time()
+        state.state_roots[slot % int(spec.SLOTS_PER_HISTORICAL_ROOT)] = root
+        state.latest_block_header.state_root = root
+        state.block_roots[slot % int(spec.SLOTS_PER_HISTORICAL_ROOT)] = hash_tree_root(
+            state.latest_block_header)
+        state.slot += 1
+        root = hash_tree_root(state)
+        slot_times.append(time.time() - t0)
+    slot_s = sorted(slot_times)[len(slot_times) // 2]
+
+    # block-ish touch: participation flags for one slot's attesters + balances
+    attesters = range(7, 7 + 2048 * 13, 13)
+    block_times = []
+    for k in range(3):
+        t0 = time.time()
+        for i in attesters:
+            state.current_epoch_participation[i % n_validators] = 7
+        for i in range(16):
+            state.balances[(k * 997 + i * 31) % n_validators] += 1
+        root = hash_tree_root(state)
+        block_times.append(time.time() - t0)
+    block_s = sorted(block_times)[len(block_times) // 2]
+
+    return {
+        "validators": n_validators,
+        "build_s": round(build_s, 2),
+        "cold_root_s": round(cold_s, 3),
+        "slot_root_s": round(slot_s, 5),
+        "block_root_s": round(block_s, 5),
+        "speedup_slot_vs_cold": round(cold_s / slot_s, 1) if slot_s else None,
+    }
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else default_validators()
+    print(json.dumps({
+        "metric": "state_root_per_slot",
+        "unit": "seconds",
+        **run(n),
+    }))
+
+
+if __name__ == "__main__":
+    main()

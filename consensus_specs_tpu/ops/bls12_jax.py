@@ -230,8 +230,9 @@ def _combine_products(prod, lo_m, hi_m):
 
 
 _FULL_PAIRS = [(i, j) for i in range(6) for j in range(6)]
-_FULL_I = jnp.asarray(np.array([i for i, _ in _FULL_PAIRS]))
-_FULL_J = jnp.asarray(np.array([j for _, j in _FULL_PAIRS]))
+# host numpy (device arrays at import would init the default backend)
+_FULL_I = np.array([i for i, _ in _FULL_PAIRS])
+_FULL_J = np.array([j for _, j in _FULL_PAIRS])
 _FULL_LO, _FULL_HI = _combine_tables(_FULL_PAIRS)
 
 
@@ -302,7 +303,7 @@ def f12_sqr(x):
 
 _SPARSE_J = (0, 3, 5)
 _SPARSE_PAIRS = [(i, j) for j in _SPARSE_J for i in range(6)]
-_SPARSE_I = jnp.asarray(np.array([i for i, _ in _SPARSE_PAIRS]))
+_SPARSE_I = np.array([i for i, _ in _SPARSE_PAIRS])
 _SPARSE_LO, _SPARSE_HI = _combine_tables(_SPARSE_PAIRS)
 
 

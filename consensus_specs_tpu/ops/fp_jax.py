@@ -34,7 +34,7 @@ P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB1
 NLIMBS = 24
 LIMB_BITS = 16
 MASK = (1 << LIMB_BITS) - 1
-BASE = jnp.uint64(1 << LIMB_BITS)
+BASE = np.uint64(1 << LIMB_BITS)  # host scalar: no backend init at import
 R = 1 << (NLIMBS * LIMB_BITS)  # 2^384
 R_MOD_P = R % P
 R2_MOD_P = (R * R) % P
@@ -49,7 +49,7 @@ to_mont = FIELD.to_mont
 from_mont_int = FIELD.from_mont_int
 
 P_LIMBS = FIELD.mod_limbs
-_P64 = jnp.asarray(P_LIMBS.astype(np.uint64))
+_P64 = P_LIMBS.astype(np.uint64)
 ZERO = FIELD.zero
 ONE_MONT = FIELD.one_mont
 
@@ -76,7 +76,7 @@ def fp_sqrt_candidate(a: jax.Array) -> jax.Array:
 
 # p·2^j limb vectors for conditional subtraction of accumulated sums (< 8p;
 # 8p < 2^384 so intermediates stay canonical in 24 limbs — 16p would not)
-_P_MULTIPLES = [jnp.asarray(int_to_limbs((P << j))).astype(jnp.uint64) for j in range(3)]
+_P_MULTIPLES = [int_to_limbs((P << j)).astype(np.uint64) for j in range(3)]
 
 
 def fp_sum_stack(arr, axis: int = 0) -> jax.Array:

@@ -49,12 +49,16 @@ class MontgomeryField:
         self.nlimbs = nlimbs
         self.limb_bits = limb_bits
         self.mask = (1 << limb_bits) - 1
-        self.base = jnp.uint64(1 << limb_bits)
+        # host numpy, NOT jnp: creating device arrays here would initialize
+        # the default (possibly remote-TPU) backend at import time, hanging
+        # every pure-host consumer (spec compiler via kzg -> fr_jax) when the
+        # tunnel is down. Under jit these trace to constants either way.
+        self.base = np.uint64(1 << limb_bits)
         self.R = 1 << (nlimbs * limb_bits)
         self.R_mod = self.R % modulus
         self.n0 = (-pow(modulus, -1, 1 << limb_bits)) % (1 << limb_bits)
         self.mod_limbs = self.int_to_limbs(modulus)
-        self._mod64 = jnp.asarray(self.mod_limbs.astype(np.uint64))
+        self._mod64 = self.mod_limbs.astype(np.uint64)
         self.one_mont = self.int_to_limbs(self.R_mod)
         self.zero = np.zeros(nlimbs, dtype=np.uint32)
 

@@ -32,25 +32,30 @@ def next_power_of_two(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-def hash_level(level: Sequence[bytes], depth: int) -> list[bytes]:
-    """Hash one level of 32-byte nodes into parents; odd tail is padded with
-    the zero-subtree root for `depth` (the level's height above the leaves).
+def hash_pairs_blob(data: bytes) -> bytes:
+    """sha256 every 64-byte pair of `data` into one 32-byte digest each.
 
     Dispatch, fastest available first: the native C++ engine
-    (native/hashtree.cpp, one ctypes roundtrip per level), the vectorized
-    numpy kernel, then per-pair hashlib."""
+    (native/hashtree.cpp, one ctypes roundtrip), the vectorized numpy
+    kernel, then per-pair hashlib."""
+    n = len(data) // 64
+    if n >= 2 and _native.available():
+        return _native.hash_pairs(data)
+    if 2 * n >= _NP_BATCH_MIN:  # threshold is in NODES (2 per pair)
+        arr = np.frombuffer(data, dtype=np.uint8).reshape(n, 64)
+        return sha256_64B(arr).tobytes()
+    return b"".join(hash_eth2(data[64 * i : 64 * (i + 1)]) for i in range(n))
+
+
+def hash_level(level: Sequence[bytes], depth: int) -> list[bytes]:
+    """Hash one level of 32-byte nodes into parents; odd tail is padded with
+    the zero-subtree root for `depth` (the level's height above the leaves)."""
     n = len(level)
     if n % 2 == 1:
         level = list(level) + [zerohashes[depth]]
         n += 1
-    if n >= 4 and _native.available():
-        out = _native.hash_pairs(b"".join(level))
-        return [out[32 * i : 32 * (i + 1)] for i in range(n // 2)]
-    if n >= _NP_BATCH_MIN:
-        arr = np.frombuffer(b"".join(level), dtype=np.uint8).reshape(n // 2, 64)
-        out = sha256_64B(arr)
-        return [out[i].tobytes() for i in range(n // 2)]
-    return [hash_eth2(level[i] + level[i + 1]) for i in range(0, n, 2)]
+    out = hash_pairs_blob(b"".join(level))
+    return [out[32 * i : 32 * (i + 1)] for i in range(n // 2)]
 
 
 def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes:
@@ -78,6 +83,90 @@ def merkleize_chunks(chunks: Sequence[bytes], limit: int | None = None) -> bytes
             return root
         level = hash_level(level, d)
     return level[0]
+
+
+class IncrementalTree:
+    """Materialized level-array merkle tree over 32-byte chunks, supporting
+    in-place chunk updates with O(dirty · log n) rehashing — the structural-
+    sharing role remerkleable's persistent tree plays for the reference
+    (eth2spec/utils/ssz/ssz_typing.py re-exports), shaped for the batched
+    hash kernels: every level's dirty parents rehash in ONE
+    `hash_pairs_blob` call.
+
+    Levels store only the data region as contiguous bytearrays (32 bytes per
+    node — 1M validators cost ~64 MB, not a pointer-heavy object tree); the
+    zero-padded tail out to `limit` is folded in virtually via `zerohashes`.
+    Structural changes (append/pop/length change) are the caller's problem:
+    rebuild the tree (sequence types set a structural flag and do exactly
+    that)."""
+
+    __slots__ = ("limit", "levels")
+
+    def __init__(self, chunks_blob: bytes, limit: int):
+        if len(chunks_blob) % 32:
+            raise ValueError("chunk blob must be a multiple of 32 bytes")
+        n = len(chunks_blob) // 32
+        if n > limit:
+            raise ValueError(f"IncrementalTree: {n} chunks exceeds limit {limit}")
+        self.limit = limit
+        self.levels = [bytearray(chunks_blob)]
+        d = 0
+        while len(self.levels[-1]) > 32:
+            cur = self.levels[-1]
+            if (len(cur) // 32) % 2:
+                cur = cur + zerohashes[d]
+            self.levels.append(bytearray(hash_pairs_blob(bytes(cur))))
+            d += 1
+
+    @property
+    def depth(self) -> int:
+        return next_power_of_two(self.limit).bit_length() - 1
+
+    def n_chunks(self) -> int:
+        return len(self.levels[0]) // 32
+
+    def root(self) -> bytes:
+        depth = self.depth
+        if not self.levels[0]:
+            return zerohashes[depth]
+        root = bytes(self.levels[-1][:32])
+        for d in range(len(self.levels) - 1, depth):
+            root = hash_eth2(root + zerohashes[d])
+        return root
+
+    def clone(self) -> "IncrementalTree":
+        """Independent deep copy (copy-on-write would save memory but the
+        updates mutate level bytes in place; clones must not share)."""
+        new = IncrementalTree.__new__(IncrementalTree)
+        new.limit = self.limit
+        new.levels = [bytearray(lv) for lv in self.levels]
+        return new
+
+    def update(self, updates: dict[int, bytes]) -> None:
+        """Overwrite chunks {index: 32-byte value} and rehash their paths.
+        Indices past the current chunk count are ignored (stale dirty marks
+        from since-popped elements)."""
+        lv0 = self.levels[0]
+        n0 = len(lv0) // 32
+        idxs = set()
+        for i, v in updates.items():
+            if i < n0:
+                lv0[32 * i : 32 * (i + 1)] = v
+                idxs.add(i >> 1)
+        for d in range(len(self.levels) - 1):
+            cur, nxt = self.levels[d], self.levels[d + 1]
+            count = len(cur) // 32
+            zh = zerohashes[d]
+            cols = sorted(idxs)
+            buf = bytearray()
+            for j in cols:
+                buf += cur[64 * j : 64 * j + 32]
+                right = cur[64 * j + 32 : 64 * j + 64]
+                buf += right if right else zh
+            out = hash_pairs_blob(bytes(buf))
+            for k, j in enumerate(cols):
+                nxt[32 * j : 32 * (j + 1)] = out[32 * k : 32 * (k + 1)]
+            idxs = {j >> 1 for j in cols}
 
 
 def mix_in_length(root: bytes, length: int) -> bytes:

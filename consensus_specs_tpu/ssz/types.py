@@ -13,12 +13,92 @@ Bitvector[N], Bitlist[N], ByteVector[N], ByteList[N], Union[...].
 """
 from __future__ import annotations
 
+import weakref
 from typing import Any, Sequence
 
-from .merkle import merkleize_chunks, mix_in_length, mix_in_selector
+from .merkle import IncrementalTree, merkleize_chunks, mix_in_length, mix_in_selector
 
 BYTES_PER_CHUNK = 32
 OFFSET_BYTE_LENGTH = 4
+
+# ---------------------------------------------------------------------------
+# Incremental-Merkleization mutation tracking (remerkleable's structural-
+# sharing role, eth2spec/utils/ssz/ssz_typing.py:4-9). Every mutable
+# composite caches its hash_tree_root and records weak links to the parents
+# holding it; any mutation invalidates the chain of caches up to the root,
+# and sequences additionally record WHICH chunk went stale so their
+# IncrementalTree rehashes only the dirty paths. Invariant maintained
+# throughout: if a value's root cache is empty, every ancestor's cache is
+# empty too — so invalidation walks stop at the first already-empty cache.
+# ---------------------------------------------------------------------------
+
+# sequences with at least this many chunks keep a materialized IncrementalTree;
+# smaller ones just re-merkleize their (element-cached) chunks on demand
+_TREE_MIN_CHUNKS = 32
+
+
+def _attach(child, parent, chunk_index: int) -> None:
+    """Record `parent` as holding `child` with the child's root feeding the
+    parent's chunk `chunk_index` (sequences use it for dirty marking;
+    containers ignore it). Weak links: a dropped parent must not be kept
+    alive by its former children."""
+    if isinstance(child, _TRACKED_TYPES):
+        entries = child.__dict__.get("_parents")
+        if entries is None:
+            object.__setattr__(child, "_parents", [(weakref.ref(parent), chunk_index)])
+            return
+        # single pass: prune dead weakrefs, detect an existing identical link
+        # (re-attachment is common — field reassignment, slice refresh — and
+        # duplicates would make every future invalidation walk them all)
+        found = False
+        w = 0
+        for entry in entries:
+            p = entry[0]()
+            if p is None:
+                continue
+            entries[w] = entry
+            w += 1
+            if p is parent and entry[1] == chunk_index:
+                found = True
+        del entries[w:]
+        if not found:
+            entries.append((weakref.ref(parent), chunk_index))
+
+
+def _mark_dirty(obj) -> None:
+    """Clear root caches from `obj` up through every live parent chain,
+    recording dirty chunk indices on sequence parents along the way."""
+    stack = [obj]
+    while stack:
+        o = stack.pop()
+        if o.__dict__.get("_root_cache") is None:
+            continue  # invariant: ancestors are already invalidated
+        object.__setattr__(o, "_root_cache", None)
+        for ref, idx in o.__dict__.get("_parents", ()):
+            p = ref()
+            if p is None:
+                continue
+            if isinstance(p, _Sequence):
+                p._note_dirty_chunk(idx)
+            stack.append(p)
+
+
+def _copy_merkle_state(src, dst) -> None:
+    """Carry cached merkle state from `src` to its fresh copy `dst`: same
+    content means same root, and the IncrementalTree clones (it is mutated
+    in place, so it must not be shared)."""
+    d = src.__dict__
+    cached = d.get("_root_cache")
+    if cached is not None:
+        object.__setattr__(dst, "_root_cache", cached)
+    tree = d.get("_tree")
+    if tree is not None:
+        object.__setattr__(dst, "_tree", tree.clone())
+        if d.get("_structural"):
+            object.__setattr__(dst, "_structural", True)
+        dirty = d.get("_dirty")
+        if dirty:
+            object.__setattr__(dst, "_dirty", set(dirty))
 
 
 class SSZType:
@@ -282,7 +362,11 @@ class ByteVector(bytes, SSZType, metaclass=_ParamMeta):
         return bytes(self)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(_pack_bytes_to_chunks(bytes(self)))
+        cached = self.__dict__.get("_root_cache")
+        if cached is None:  # immutable: cache once, no invalidation needed
+            cached = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)))
+            object.__setattr__(self, "_root_cache", cached)
+        return cached
 
     def copy(self):
         return self
@@ -333,9 +417,13 @@ class ByteList(bytes, SSZType, metaclass=_ParamMeta):
         return bytes(self)
 
     def hash_tree_root(self) -> bytes:
-        limit_chunks = (self.LIMIT + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
-        root = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=limit_chunks)
-        return mix_in_length(root, len(self))
+        cached = self.__dict__.get("_root_cache")
+        if cached is None:  # immutable: cache once, no invalidation needed
+            limit_chunks = (self.LIMIT + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+            root = merkleize_chunks(_pack_bytes_to_chunks(bytes(self)), limit=limit_chunks)
+            cached = mix_in_length(root, len(self))
+            object.__setattr__(self, "_root_cache", cached)
+        return cached
 
     def copy(self):
         return self
@@ -418,7 +506,12 @@ class Bitvector(SSZType, metaclass=_ParamMeta):
         return bytes(out)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(_pack_bytes_to_chunks(self.encode_bytes()))
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
+        root = merkleize_chunks(_pack_bytes_to_chunks(self.encode_bytes()))
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def copy(self):
         return type(self)(list(self._bits))
@@ -438,6 +531,7 @@ class Bitvector(SSZType, metaclass=_ParamMeta):
             self._bits = new
         else:
             self._bits[i] = bool(v)
+        _mark_dirty(self)
 
     def __iter__(self):
         return iter(self._bits)
@@ -507,10 +601,14 @@ class Bitlist(SSZType, metaclass=_ParamMeta):
         return _bits_to_bytes(bits)
 
     def hash_tree_root(self) -> bytes:
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
         limit_chunks = (self.LIMIT + 255) // 256
         chunks = _pack_bytes_to_chunks(_bits_to_bytes(self._bits)) if self._bits else []
-        root = merkleize_chunks(chunks, limit=limit_chunks)
-        return mix_in_length(root, len(self._bits))
+        root = mix_in_length(merkleize_chunks(chunks, limit=limit_chunks), len(self._bits))
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def copy(self):
         return type(self)(list(self._bits))
@@ -519,6 +617,7 @@ class Bitlist(SSZType, metaclass=_ParamMeta):
         if len(self._bits) >= self.LIMIT:
             raise ValueError(f"{type(self).__name__}: append past limit")
         self._bits.append(bool(v))
+        _mark_dirty(self)
 
     def __len__(self):
         return len(self._bits)
@@ -528,6 +627,7 @@ class Bitlist(SSZType, metaclass=_ParamMeta):
 
     def __setitem__(self, i, v):
         self._bits[i] = bool(v)
+        _mark_dirty(self)
 
     def __iter__(self):
         return iter(self._bits)
@@ -564,6 +664,89 @@ class _Sequence(SSZType):
     def _coerce_elems(self, elems):
         return [self.ELEM_TYPE.coerce(e) if not isinstance(e, self.ELEM_TYPE) else e for e in elems]
 
+    # --- incremental-merkleization bookkeeping ------------------------------
+
+    @classmethod
+    def _elems_tracked(cls) -> bool:
+        """Whether elements are mutable composites needing parent links
+        (uints/booleans/bytes are immutable: only __setitem__ can change
+        their chunk, which marks it directly)."""
+        t = cls.__dict__.get("_elems_tracked_cache")
+        if t is None:
+            t = isinstance(cls.ELEM_TYPE, type) and issubclass(cls.ELEM_TYPE, _TRACKED_TYPES)
+            cls._elems_tracked_cache = t
+        return t
+
+    @classmethod
+    def _chunk_index(cls, i: int) -> int:
+        et = cls.ELEM_TYPE
+        if _is_basic(et):
+            return (i * et.type_byte_length()) // BYTES_PER_CHUNK
+        return i
+
+    def _attach_all(self) -> None:
+        if self._elems_tracked():
+            for i, e in enumerate(self._elems):
+                _attach(e, self, self._chunk_index(i))
+
+    def _note_dirty_chunk(self, ci: int) -> None:
+        d = self.__dict__.get("_dirty")
+        if d is None:
+            d = set()
+            object.__setattr__(self, "_dirty", d)
+        d.add(ci)
+
+    def _mark_structural(self) -> None:
+        """Length/layout changed: the IncrementalTree rebuilds at next hash
+        (element root caches still make the rebuild cheap)."""
+        object.__setattr__(self, "_structural", True)
+        _mark_dirty(self)
+
+    def _chunk_bytes(self, ci: int) -> bytes | None:
+        """Current 32-byte value of chunk `ci`, or None if out of range
+        (stale dirty mark from a since-removed element)."""
+        et = self.ELEM_TYPE
+        if _is_basic(et):
+            per = BYTES_PER_CHUNK // et.type_byte_length()
+            seg = self._elems[ci * per:(ci + 1) * per]
+            if not seg:
+                return None
+            data = b"".join(e.encode_bytes() for e in seg)
+            return data + b"\x00" * (BYTES_PER_CHUNK - len(data))
+        if ci >= len(self._elems):
+            return None
+        return self._elems[ci].hash_tree_root()
+
+    def _merkle_root(self, limit_chunks: int | None) -> bytes:
+        """Chunk-tree root (before any length mix-in), maintained
+        incrementally: dirty chunks rehash O(dirty · log n) through the
+        cached IncrementalTree; structural changes rebuild it."""
+        tree = self.__dict__.get("_tree")
+        if tree is not None and not self.__dict__.get("_structural"):
+            dirty = self.__dict__.get("_dirty")
+            if dirty:
+                updates = {}
+                for ci in dirty:
+                    v = self._chunk_bytes(ci)
+                    if v is not None:
+                        updates[ci] = v
+                tree.update(updates)
+                dirty.clear()
+            return tree.root()
+        chunks = self._chunks()
+        dirty = self.__dict__.get("_dirty")
+        if dirty:
+            dirty.clear()
+        object.__setattr__(self, "_structural", False)
+        if len(chunks) >= _TREE_MIN_CHUNKS:
+            tree = IncrementalTree(
+                b"".join(chunks),
+                len(chunks) if limit_chunks is None else limit_chunks)
+            object.__setattr__(self, "_tree", tree)
+            return tree.root()
+        object.__setattr__(self, "_tree", None)
+        return merkleize_chunks(chunks, limit=limit_chunks)
+
     def __len__(self):
         return len(self._elems)
 
@@ -581,8 +764,19 @@ class _Sequence(SSZType):
             new[i] = self._coerce_elems(v)
             self._check_length(len(new))
             self._elems = new
+            # positions may have shifted: refresh every parent link (stale
+            # old-index links only cause spurious rehashes, never staleness)
+            self._attach_all()
+            self._mark_structural()
         else:
-            self._elems[i] = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
+            value = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
+            self._elems[i] = value
+            if i < 0:
+                i += len(self._elems)
+            ci = self._chunk_index(i)
+            _attach(value, self, ci)
+            self._note_dirty_chunk(ci)
+            _mark_dirty(self)
 
     def _check_length(self, n: int) -> None:
         raise NotImplementedError
@@ -643,6 +837,7 @@ class _Sequence(SSZType):
         out = cls.__new__(cls)
         out._elems = [et(v) for v in values]
         out._check_length(len(out._elems))
+        out._attach_all()  # no-op for basic elems; REQUIRED for tracked ones
         return out
 
     # --- shared serialization over self._elems ---
@@ -711,10 +906,18 @@ class Vector(_Sequence, metaclass=_ParamMeta):
         if len(elems) != self.LENGTH:
             raise ValueError(f"{type(self).__name__}: expected {self.LENGTH} elements, got {len(elems)}")
         self._elems = self._coerce_elems(elems)
+        self._attach_all()
 
     def _check_length(self, n: int) -> None:
         if n != self.LENGTH:
             raise ValueError(f"{type(self).__name__}: mutation would change length to {n}")
+
+    @classmethod
+    def chunk_count(cls) -> int:
+        if _is_basic(cls.ELEM_TYPE):
+            return (cls.LENGTH * cls.ELEM_TYPE.type_byte_length()
+                    + BYTES_PER_CHUNK - 1) // BYTES_PER_CHUNK
+        return cls.LENGTH
 
     @classmethod
     def is_fixed_size(cls) -> bool:
@@ -744,10 +947,17 @@ class Vector(_Sequence, metaclass=_ParamMeta):
         return cls(elems)
 
     def hash_tree_root(self) -> bytes:
-        return merkleize_chunks(self._chunks())
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
+        root = self._merkle_root(self.chunk_count())
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def copy(self):
-        return type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+        new = type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+        _copy_merkle_state(self, new)
+        return new
 
 
 class List(_Sequence, metaclass=_ParamMeta):
@@ -766,6 +976,7 @@ class List(_Sequence, metaclass=_ParamMeta):
         if len(elems) > self.LIMIT:
             raise ValueError(f"{type(self).__name__}: {len(elems)} elements exceeds limit {self.LIMIT}")
         self._elems = self._coerce_elems(elems)
+        self._attach_all()
 
     def _check_length(self, n: int) -> None:
         if n > self.LIMIT:
@@ -801,21 +1012,32 @@ class List(_Sequence, metaclass=_ParamMeta):
         return cls.LIMIT
 
     def hash_tree_root(self) -> bytes:
-        root = merkleize_chunks(self._chunks(), limit=self.chunk_limit())
-        return mix_in_length(root, len(self._elems))
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
+        root = mix_in_length(self._merkle_root(self.chunk_limit()), len(self._elems))
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def copy(self):
-        return type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+        new = type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
+        _copy_merkle_state(self, new)
+        return new
 
     def append(self, v):
         if len(self._elems) >= self.LIMIT:
             raise ValueError(f"{type(self).__name__}: append past limit")
-        self._elems.append(v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v))
+        value = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
+        self._elems.append(value)
+        _attach(value, self, self._chunk_index(len(self._elems) - 1))
+        self._mark_structural()
 
     def pop(self):
         if not self._elems:
             raise IndexError("pop from empty List")
-        return self._elems.pop()
+        value = self._elems.pop()
+        self._mark_structural()
+        return value
 
 
 # ---------------------------------------------------------------------------
@@ -860,6 +1082,7 @@ class Container(SSZType):
             else:
                 value = typ.default()
             object.__setattr__(self, name, value)
+            _attach(value, self, 0)
 
     def __setattr__(self, name, value):
         fields = self.fields()
@@ -867,6 +1090,10 @@ class Container(SSZType):
             typ = fields[name]
             if not isinstance(value, typ):
                 value = typ.coerce(value)
+            object.__setattr__(self, name, value)
+            _attach(value, self, 0)
+            _mark_dirty(self)
+            return
         object.__setattr__(self, name, value)
 
     @classmethod
@@ -953,15 +1180,24 @@ class Container(SSZType):
         return cls(**values)
 
     def hash_tree_root(self) -> bytes:
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
         chunks = [getattr(self, name).hash_tree_root() for name in self.fields()]
-        return merkleize_chunks(chunks)
+        root = merkleize_chunks(chunks)
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def copy(self):
-        return type(self)(**{
+        new = type(self)(**{
             name: (v.copy() if hasattr(v, "copy") else v)
             for name in self.fields()
             for v in [getattr(self, name)]
         })
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:  # identical content, identical root
+            object.__setattr__(new, "_root_cache", cached)
+        return new
 
     def __eq__(self, other):
         return type(self) is type(other) and all(
@@ -1035,13 +1271,25 @@ class Union(SSZType, metaclass=_ParamMeta):
             return cls(0, None)
         return cls(selector, typ.decode_bytes(data[1:]))
 
+    def __setattr__(self, name, value):
+        object.__setattr__(self, name, value)
+        if name == "value":
+            _attach(value, self, 0)
+        if name in ("selector", "value"):
+            _mark_dirty(self)
+
     def encode_bytes(self) -> bytes:
         body = b"" if self.value is None else self.value.encode_bytes()
         return bytes([self.selector]) + body
 
     def hash_tree_root(self) -> bytes:
+        cached = self.__dict__.get("_root_cache")
+        if cached is not None:
+            return cached
         root = b"\x00" * 32 if self.value is None else self.value.hash_tree_root()
-        return mix_in_selector(root, self.selector)
+        root = mix_in_selector(root, self.selector)
+        object.__setattr__(self, "_root_cache", root)
+        return root
 
     def change(self, selector: int, value=None):
         """In-place re-tag (the sharding spec's `status.change(...)` idiom on
@@ -1062,3 +1310,9 @@ class Union(SSZType, metaclass=_ParamMeta):
 
     def __repr__(self):
         return f"{type(self).__name__}(selector={self.selector}, value={self.value!r})"
+
+
+# Mutable composites participating in invalidation tracking (immutable
+# values — uints, booleans, byte types — need no parent links: only the
+# holder's own __setitem__/__setattr__ can change their slot).
+_TRACKED_TYPES = (Container, _Sequence, Bitvector, Bitlist, Union)

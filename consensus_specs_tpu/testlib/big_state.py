@@ -1,0 +1,71 @@
+"""Registry-scale synthetic BeaconState builder.
+
+Builds a structurally valid spec `BeaconState` with `n` validators fast
+enough to benchmark at 1M (BASELINE.md configs 3/4: registry-scale epoch
+processing and state-root hashing). Keys are deterministic fakes — state
+hashing and epoch math don't verify them; scenarios needing real signatures
+(testlib/attestations.py) sign per-committee with the shared test keypairs
+instead.
+
+Reference analog: the reference builds big states only through genesis
+helpers (test/helpers/genesis.py), which is deposit-by-deposit and far too
+slow past ~100k validators; this builder fills the state columns directly.
+"""
+from __future__ import annotations
+
+
+def fake_pubkey(i: int) -> bytes:
+    return b"\xaa" + i.to_bytes(8, "little") + b"\x00" * 39
+
+
+def synthetic_beacon_state(spec, n: int, slot: int = 3200):
+    """A `spec.BeaconState` with `n` active max-balance validators, filled
+    historical vectors, and (post-phase0) participation/sync fields."""
+    far_future = spec.FAR_FUTURE_EPOCH
+    epoch = slot // spec.SLOTS_PER_EPOCH
+    V = spec.Validator
+    validators = [
+        V(
+            pubkey=fake_pubkey(i),
+            withdrawal_credentials=bytes(spec.BLS_WITHDRAWAL_PREFIX) + i.to_bytes(31, "little"),
+            effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+            activation_eligibility_epoch=0,
+            activation_epoch=0,
+            exit_epoch=far_future,
+            withdrawable_epoch=far_future,
+        )
+        for i in range(n)
+    ]
+    state = spec.BeaconState(
+        genesis_time=1_600_000_000,
+        slot=slot,
+        fork=spec.Fork(current_version=spec.config.GENESIS_FORK_VERSION),
+        latest_block_header=spec.BeaconBlockHeader(slot=slot - 1),
+        validators=validators,
+        eth1_deposit_index=n,
+        previous_justified_checkpoint=spec.Checkpoint(epoch=epoch - 2),
+        current_justified_checkpoint=spec.Checkpoint(epoch=epoch - 1),
+        finalized_checkpoint=spec.Checkpoint(epoch=epoch - 2),
+    )
+    state.balances = type(state.balances).from_values(
+        [int(spec.MAX_EFFECTIVE_BALANCE)] * n)
+    for i in range(len(state.block_roots)):
+        state.block_roots[i] = spec.Root((i % 251 + 1).to_bytes(32, "little"))
+        state.state_roots[i] = spec.Root((i % 241 + 1).to_bytes(32, "big"))
+    for i in range(len(state.randao_mixes)):
+        state.randao_mixes[i] = spec.Bytes32((i % 253 + 1).to_bytes(32, "little"))
+    fields = spec.BeaconState.fields()
+    if "previous_epoch_participation" in fields:  # altair+
+        part_t = type(state.previous_epoch_participation)
+        state.previous_epoch_participation = part_t.from_values([7] * n)
+        state.current_epoch_participation = part_t.from_values([3] * n)
+        state.inactivity_scores = type(state.inactivity_scores).from_values([0] * n)
+        committee = spec.SyncCommittee(
+            pubkeys=[fake_pubkey(i % n) for i in range(spec.SYNC_COMMITTEE_SIZE)],
+            aggregate_pubkey=fake_pubkey(0),
+        )
+        state.current_sync_committee = committee
+        state.next_sync_committee = committee.copy()
+    if "previous_epoch_attestations" in fields:  # phase0
+        pass  # left empty: pending attestations accumulate per block
+    return state
