@@ -42,9 +42,15 @@ REFERENCE_SPECS = Path("/root/reference/specs")
 # protocol (beacon-chain is the whole state transition).
 REFERENCE_DOCS = {
     "phase0": ["phase0/beacon-chain.md"],
-    # overlay order mirrors the compiler: altair's functions supersede
-    # phase0's where redefined
+    # overlay order mirrors the compiler: later forks' functions supersede
+    # earlier ones where redefined
     "altair": ["phase0/beacon-chain.md", "altair/beacon-chain.md", "altair/bls.md"],
+    "bellatrix": [
+        "phase0/beacon-chain.md",
+        "altair/beacon-chain.md",
+        "altair/bls.md",
+        "bellatrix/beacon-chain.md",
+    ],
 }
 
 
@@ -86,6 +92,42 @@ def build_reference_semantics(fork: str = "phase0", preset: str = "minimal"):
     assert executed > 50, f"suspiciously few reference blocks executed: {executed}"
     _CACHE[key] = module
     return module
+
+
+def reference_container_layouts(fork: str = "phase0") -> dict:
+    """{ClassName: [(field_name, annotation_source), ...]} parsed from the
+    reference markdown's `class X(Container)` blocks, overlay order applied
+    (newest fork's definition wins) — the structural complement to the
+    function differential: `build_reference_semantics` deliberately skips
+    class blocks to keep container identity, so a field-layout divergence
+    between our containers and the reference's would otherwise only
+    (maybe) surface through ssz_static vectors (VERDICT r2 weak #7)."""
+    import ast
+
+    layouts: dict = {}
+    for doc_path in REFERENCE_DOCS[fork]:
+        text = (REFERENCE_SPECS / doc_path).read_text()
+        for block in parse_spec_markdown(text).python_blocks:
+            if not block.lstrip().startswith("class "):
+                continue
+            try:
+                tree = ast.parse(block)
+            except SyntaxError:
+                continue
+            for node in tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {ast.unparse(b) for b in node.bases}
+                if "Container" not in bases:
+                    continue  # dataclasses (Store etc.) and helpers
+                fields = [
+                    (stmt.target.id, ast.unparse(stmt.annotation))
+                    for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign) and hasattr(stmt.target, "id")
+                ]
+                if fields:
+                    layouts[node.name] = fields
+    return layouts
 
 
 # Functions compared state-to-state by the differential test; each entry is

@@ -1,101 +1,385 @@
 """Dual-mode rewards tests: per-component reward/penalty delta vectors.
 
-Vector format (reference tests/formats/rewards): pre.ssz_snappy plus one
-Deltas {rewards: List[uint64], penalties: List[uint64]} per component —
-source/target/head for both fork families, inclusion_delay phase0-only
-(altair folds timeliness into the flag weights), inactivity for both.
-Reference parity: test/helpers/rewards.py run_deltas harness (:19-100) and
-the phase0/altair rewards suites.
+Scenario matrix in the shape of the reference's
+phase0/rewards/{test_basic,test_leak,test_random}.py suites (~50 scenarios)
+driven through the run_deltas harness (testlib/rewards.py — the
+test/helpers/rewards.py:19-100 role): full/empty/partial participation,
+slashed and exited sets, inactivity leaks, per-flag isolation, seeded
+random participation, and low/misc balance profiles. Every scenario
+validates each component's invariants AND the total-consistency oracle
+(component sum == real process_rewards_and_penalties movement).
+
+Vector format (tests/formats/rewards/README.md): pre.ssz_snappy plus one
+Deltas {rewards, penalties} part per component — source/target/head for
+both fork families, inclusion_delay phase0-only (altair folds timeliness
+into the flag weights), inactivity for both.
 """
-from ..ssz.types import Container, List, uint64
+import random
+
 from ..testlib.attestations import add_attestations_for_epoch
-from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.context import (
+    _low_threshold,
+    low_balances,
+    misc_balances,
+    spec_configured_state_test,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from ..testlib.rewards import (
+    Deltas,
+    exit_fraction,
+    is_post_altair,
+    make_deltas as _deltas,  # re-export: conformance/runner.py imports both
+    put_in_leak,
+    run_deltas,
+    set_flag_only,
+    set_participation_fraction,
+    set_random_participation,
+    slash_fraction,
+)
 from ..testlib.state import next_epoch, set_full_participation_previous_epoch
 
-
-class Deltas(Container):
-    rewards: List[uint64, 2**40]
-    penalties: List[uint64, 2**40]
+ALTAIR_FAMILY = ["altair", "bellatrix"]
 
 
-def _deltas(pair):
-    rewards, penalties = pair
-    return Deltas(
-        rewards=List[uint64, 2**40](*[int(x) for x in rewards]),
-        penalties=List[uint64, 2**40](*[int(x) for x in penalties]),
-    )
+def _prepare(spec, state, participation: float | None = 1.0, pre_fn=None):
+    """Advance past the genesis no-op epoch and install participation.
 
-
-def _prepare_participation(spec, state):
-    """Advance past genesis and mark previous-epoch participation so every
-    delta component has signal."""
+    `pre_fn` runs BEFORE participation is installed — registry changes that
+    alter committee composition (exits) must happen first, or phase0's
+    pending-attestation bits no longer line up with the reconstructed
+    committees."""
     next_epoch(spec, state)
     next_epoch(spec, state)
-    if hasattr(state, "previous_epoch_participation"):
+    if pre_fn is not None:
+        pre_fn()
+    if participation is None:
+        return
+    if is_post_altair(state):
+        set_full_participation_previous_epoch(spec, state)
+    else:
+        add_attestations_for_epoch(spec, state, spec.get_previous_epoch(state))
+    if participation < 1.0:
+        set_participation_fraction(spec, state, participation)
+
+
+def _enter_leak(spec, state):
+    """Advance into an inactivity leak, then re-install full participation
+    (put_in_leak's epoch advancing rotates away the earlier installation)."""
+    put_in_leak(spec, state)
+    if is_post_altair(state):
         set_full_participation_previous_epoch(spec, state)
     else:
         add_attestations_for_epoch(spec, state, spec.get_previous_epoch(state))
 
 
-def _component_deltas(spec, state):
-    """(name, Deltas) per component, fork-appropriate."""
-    if hasattr(state, "previous_epoch_participation"):  # altair family
-        flags = [
-            ("source_deltas", spec.TIMELY_SOURCE_FLAG_INDEX),
-            ("target_deltas", spec.TIMELY_TARGET_FLAG_INDEX),
-            ("head_deltas", spec.TIMELY_HEAD_FLAG_INDEX),
-        ]
-        for name, idx in flags:
-            yield name, _deltas(spec.get_flag_index_deltas(state, idx))
-    else:
-        yield "source_deltas", _deltas(spec.get_source_deltas(state))
-        yield "target_deltas", _deltas(spec.get_target_deltas(state))
-        yield "head_deltas", _deltas(spec.get_head_deltas(state))
-        yield "inclusion_delay_deltas", _deltas(spec.get_inclusion_delay_deltas(state))
-    yield "inactivity_penalty_deltas", _deltas(spec.get_inactivity_penalty_deltas(state))
+# --- basic -------------------------------------------------------------------
 
 
 @with_all_phases
 @spec_state_test
-def test_full_participation(spec, state):
-    _prepare_participation(spec, state)
-    yield "pre", state.copy()
-    total_rewarded = 0
-    for name, deltas in _component_deltas(spec, state):
-        # full participation earns in every component outside leaks
-        total_rewarded += sum(int(r) for r in deltas.rewards)
-        yield name, deltas
-    assert total_rewarded > 0
+def test_full_all_correct(spec, state):
+    _prepare(spec, state, 1.0)
+    yield from run_deltas(spec, state)
 
 
 @with_all_phases
 @spec_state_test
-def test_empty_participation(spec, state):
-    next_epoch(spec, state)
-    next_epoch(spec, state)
-    yield "pre", state.copy()
-    for name, deltas in _component_deltas(spec, state):
-        # nobody participated: zero rewards; eligible validators penalized
-        # in the penalizing components
-        assert sum(int(r) for r in deltas.rewards) == 0
-        yield name, deltas
+def test_empty(spec, state):
+    _prepare(spec, state, None)
+    yield from run_deltas(spec, state)
 
 
 @with_all_phases
 @spec_state_test
-def test_half_participation(spec, state):
-    _prepare_participation(spec, state)
-    # wipe participation for the second half of the registry
+def test_half_full(spec, state):
+    _prepare(spec, state, 0.5)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_quarter_full(spec, state):
+    _prepare(spec, state, 0.25)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_empty(spec, state):
+    """A single participating validator."""
+    _prepare(spec, state, 1.0)
+    set_participation_fraction(spec, state, 1.0 / len(state.validators) + 1e-9)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_almost_full(spec, state):
+    """Exactly one idle validator."""
+    _prepare(spec, state, 1.0)
+    set_participation_fraction(
+        spec, state, (len(state.validators) - 1) / len(state.validators))
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_with_slashed_third(spec, state):
+    _prepare(spec, state, 1.0)
+    slash_fraction(spec, state, 1 / 3)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_with_slashed_third(spec, state):
+    _prepare(spec, state, None)
+    slash_fraction(spec, state, 1 / 3)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_with_exited_fraction(spec, state):
+    """Exited (unslashed) validators are delta-ineligible."""
+    _prepare(spec, state, 1.0, pre_fn=lambda: exit_fraction(spec, state, 0.25))
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_with_exits_and_slashings(spec, state):
+    _prepare(spec, state, 0.5, pre_fn=lambda: exit_fraction(spec, state, 0.125))
+    slash_fraction(spec, state, 0.0625)
+    yield from run_deltas(spec, state)
+
+
+# --- leak --------------------------------------------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_full(spec, state):
+    _prepare(spec, state, 1.0)
+    _enter_leak(spec, state)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_empty(spec, state):
+    _prepare(spec, state, None)
+    put_in_leak(spec, state)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_half(spec, state):
+    _prepare(spec, state, 1.0)
+    _enter_leak(spec, state)
+    set_participation_fraction(spec, state, 0.5)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_quarter(spec, state):
+    _prepare(spec, state, 1.0)
+    _enter_leak(spec, state)
+    set_participation_fraction(spec, state, 0.25)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_with_slashed(spec, state):
+    _prepare(spec, state, 1.0)
+    _enter_leak(spec, state)
+    slash_fraction(spec, state, 0.2)
+    yield from run_deltas(spec, state)
+
+
+# --- random ------------------------------------------------------------------
+
+
+def _random_case(spec, state, seed: int, leak: bool = False):
+    _prepare(spec, state, 1.0)
+    if leak:
+        _enter_leak(spec, state)
+    rng = random.Random(seed)
+    set_random_participation(spec, state, rng)
+    if rng.random() < 0.5:
+        slash_fraction(spec, state, rng.uniform(0.05, 0.3))
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_0(spec, state):
+    yield from _random_case(spec, state, 1010)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_1(spec, state):
+    yield from _random_case(spec, state, 2020)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_2(spec, state):
+    yield from _random_case(spec, state, 3030)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_3(spec, state):
+    yield from _random_case(spec, state, 4040)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_leak_0(spec, state):
+    yield from _random_case(spec, state, 5050, leak=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_leak_1(spec, state):
+    yield from _random_case(spec, state, 6060, leak=True)
+
+
+# --- balance profiles --------------------------------------------------------
+
+
+@with_all_phases
+@spec_configured_state_test(low_balances, _low_threshold)
+def test_full_low_balances(spec, state):
+    _prepare(spec, state, 1.0)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_configured_state_test(low_balances, _low_threshold)
+def test_empty_low_balances(spec, state):
+    _prepare(spec, state, None)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_configured_state_test(misc_balances)
+def test_half_misc_balances(spec, state):
+    _prepare(spec, state, 0.5)
+    yield from run_deltas(spec, state)
+
+
+@with_all_phases
+@spec_configured_state_test(misc_balances)
+def test_random_misc_balances(spec, state):
+    yield from _random_case(spec, state, 7070)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_with_low_effective_balance(spec, state):
+    """Some validators at the ejection-balance floor: rewards scale with
+    effective balance, so these must earn strictly less than full-weight
+    peers (spot-checked), while invariants still hold."""
+    _prepare(spec, state, 1.0)
+    floor = int(spec.config.EJECTION_BALANCE)
     n = len(state.validators)
-    if hasattr(state, "previous_epoch_participation"):
-        for i in range(n // 2, n):
-            state.previous_epoch_participation[i] = spec.ParticipationFlags(0)
-    else:
-        # keep only attestations whose committees fall in the first half is
-        # fiddly with aggregate bits; for phase0, drop every other pending
-        # attestation instead
-        kept = [a for i, a in enumerate(state.previous_epoch_attestations) if i % 2 == 0]
-        state.previous_epoch_attestations = kept
-    yield "pre", state.copy()
-    for name, deltas in _component_deltas(spec, state):
-        yield name, deltas
+    for i in range(0, n, 4):
+        state.validators[i].effective_balance = floor
+    parts = list(run_deltas(spec, state))
+    name_to_deltas = dict(p for p in parts if p[0] != "pre")
+    target = name_to_deltas["target_deltas"]
+    low, full = int(target.rewards[0]), int(target.rewards[1])
+    if full:
+        assert low < full, "floor-balance validator out-earned a full-weight one"
+    yield from iter(parts)
+
+
+# --- altair-family flag isolation -------------------------------------------
+
+
+@with_phases(ALTAIR_FAMILY)
+@spec_state_test
+def test_altair_source_flag_only(spec, state):
+    _prepare(spec, state, None)
+    set_flag_only(spec, state, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    yield from run_deltas(spec, state)
+
+
+@with_phases(ALTAIR_FAMILY)
+@spec_state_test
+def test_altair_target_flag_only(spec, state):
+    _prepare(spec, state, None)
+    set_flag_only(spec, state, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    yield from run_deltas(spec, state)
+
+
+@with_phases(ALTAIR_FAMILY)
+@spec_state_test
+def test_altair_head_flag_only(spec, state):
+    _prepare(spec, state, None)
+    set_flag_only(spec, state, int(spec.TIMELY_HEAD_FLAG_INDEX))
+    yield from run_deltas(spec, state)
+
+
+@with_phases(ALTAIR_FAMILY)
+@spec_state_test
+def test_altair_inactivity_scores_spread(spec, state):
+    """Non-leak state with nonzero inactivity scores: score-carrying
+    non-participants still pay inactivity penalties."""
+    _prepare(spec, state, 0.5)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i in range(len(state.validators)):
+        state.inactivity_scores[i] = (i % 7) * bias
+    yield from run_deltas(spec, state)
+
+
+@with_phases(ALTAIR_FAMILY)
+@spec_state_test
+def test_altair_leak_inactivity_scores(spec, state):
+    _prepare(spec, state, 1.0)
+    _enter_leak(spec, state)
+    set_participation_fraction(spec, state, 0.5)
+    yield from run_deltas(spec, state)
+
+
+# --- phase0-specific ---------------------------------------------------------
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_phase0_late_inclusion(spec, state):
+    """Stretch inclusion delays: inclusion-delay rewards shrink with delay
+    (1/delay scaling) but never go negative."""
+    _prepare(spec, state, 1.0)
+    for att in state.previous_epoch_attestations:
+        att.inclusion_delay = spec.SLOTS_PER_EPOCH // 2
+    parts = list(run_deltas(spec, state))
+    yield from iter(parts)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_phase0_mixed_inclusion_delays(spec, state):
+    _prepare(spec, state, 1.0)
+    for k, att in enumerate(state.previous_epoch_attestations):
+        att.inclusion_delay = 1 + (k % int(spec.SLOTS_PER_EPOCH // 2))
+    yield from run_deltas(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_phase0_wrong_target(spec, state):
+    """Source-correct but target-wrong pending attestations: source component
+    pays, target/head components penalize."""
+    _prepare(spec, state, 1.0)
+    for att in state.previous_epoch_attestations:
+        att.data.target.root = spec.Root(b"\x42" * 32)
+    yield from run_deltas(spec, state)

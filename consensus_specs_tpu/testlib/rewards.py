@@ -1,0 +1,304 @@
+"""Rewards-delta harness: per-component isolation with invariant checks.
+
+The `run_deltas` role of the reference (test/helpers/rewards.py:19-100):
+compute every reward/penalty component in isolation from one pre-state,
+validate each against independently-derived participation sets, and emit
+the `Deltas` vector parts. On top of the reference's per-component checks
+this harness closes the loop with a TOTAL-consistency oracle: the summed
+component deltas must equal the balance changes an actual
+`process_rewards_and_penalties` run produces on a copy of the state.
+
+Works across both fork families: phase0 (pending-attestation derived) and
+altair+ (participation-flag derived).
+
+NOTE: no `from __future__ import annotations` here — Container fields are
+resolved from the class annotations as real type objects.
+"""
+import random
+
+from ..ssz.types import Container, List, uint64
+from .state import next_epoch
+
+REGISTRY_LIMIT = 2**40
+
+
+class Deltas(Container):
+    rewards: List[uint64, REGISTRY_LIMIT]
+    penalties: List[uint64, REGISTRY_LIMIT]
+
+
+def make_deltas(pair) -> Deltas:
+    rewards, penalties = pair
+    return Deltas(
+        rewards=List[uint64, REGISTRY_LIMIT](*[int(x) for x in rewards]),
+        penalties=List[uint64, REGISTRY_LIMIT](*[int(x) for x in penalties]),
+    )
+
+
+def is_post_altair(state) -> bool:
+    return hasattr(state, "previous_epoch_participation")
+
+
+# --- participation scenario setters -----------------------------------------
+
+
+def set_participation_fraction(spec, state, fraction: float) -> None:
+    """Leave the first `fraction` of the registry fully participating in the
+    previous epoch, the rest idle."""
+    n = len(state.validators)
+    cut = int(n * fraction)
+    if is_post_altair(state):
+        full = spec.ParticipationFlags(0b111)
+        for i in range(n):
+            state.previous_epoch_participation[i] = (
+                full if i < cut else spec.ParticipationFlags(0))
+    else:
+        _filter_pending_attestation_bits(spec, state, lambda i: i < cut)
+
+
+def set_random_participation(spec, state, rng: random.Random) -> None:
+    if is_post_altair(state):
+        for i in range(len(state.validators)):
+            flags = 0
+            for flag_index in range(3):
+                if rng.random() < 0.55:
+                    flags |= 1 << flag_index
+            # target participation implies source in real attestation flows;
+            # random flags are fine for delta math (components read flags
+            # independently) but keep them plausible: head implies target
+            if flags & 0b100:
+                flags |= 0b010
+            if flags & 0b010:
+                flags |= 0b001
+            state.previous_epoch_participation[i] = spec.ParticipationFlags(flags)
+    else:
+        _filter_pending_attestation_bits(spec, state, lambda i: rng.random() < 0.55)
+
+
+def set_flag_only(spec, state, flag_index: int) -> None:
+    """Altair family: every validator participates in exactly one duty flag
+    (plus implied lower flags for target/head plausibility is NOT applied —
+    the point is component isolation)."""
+    flags = spec.ParticipationFlags(1 << flag_index)
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = flags
+
+
+def _filter_pending_attestation_bits(spec, state, keep_fn) -> None:
+    """phase0: clear aggregation bits of previous-epoch pending attestations
+    for validators where keep_fn(validator_index) is false."""
+    for att in state.previous_epoch_attestations:
+        committee = spec.get_beacon_committee(
+            state, att.data.slot, att.data.index)
+        for pos, vidx in enumerate(committee):
+            if att.aggregation_bits[pos] and not keep_fn(int(vidx)):
+                att.aggregation_bits[pos] = False
+
+
+def slash_fraction(spec, state, fraction: float) -> None:
+    """Mark a prefix of the registry slashed (still withdrawable in the
+    future, so they remain delta-eligible)."""
+    current = spec.get_current_epoch(state)
+    for i in range(int(len(state.validators) * fraction)):
+        v = state.validators[i]
+        # participation flags/pending bits stay as-is: the spec's
+        # unslashed-set filtering is what must exclude these validators
+        v.slashed = True
+        v.withdrawable_epoch = current + spec.EPOCHS_PER_SLASHINGS_VECTOR
+
+
+def exit_fraction(spec, state, fraction: float) -> None:
+    """Exit a prefix of the registry as of two epochs ago (inactive AND not
+    slashed => ineligible for deltas)."""
+    current = spec.get_current_epoch(state)
+    for i in range(int(len(state.validators) * fraction)):
+        v = state.validators[i]
+        v.exit_epoch = max(spec.GENESIS_EPOCH, current - 2)
+        v.withdrawable_epoch = v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+
+
+def put_in_leak(spec, state, extra_epochs: int = 3) -> None:
+    """Advance far enough past the (never-updated) finalized checkpoint that
+    is_in_inactivity_leak flips on."""
+    target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 1 + extra_epochs
+    while spec.get_previous_epoch(state) - state.finalized_checkpoint.epoch <= target:
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    if is_post_altair(state):
+        # leaked epochs accrue inactivity scores; model a plausible spread
+        for i in range(len(state.validators)):
+            state.inactivity_scores[i] = uint64(
+                (i % 5) * int(spec.config.INACTIVITY_SCORE_BIAS))
+
+
+# --- participation sets (independent of the delta functions) -----------------
+
+
+def eligible_indices(spec, state) -> set:
+    return set(int(i) for i in spec.get_eligible_validator_indices(state))
+
+
+def duty_participants(spec, state, duty: str) -> set:
+    """Unslashed previous-epoch participants for duty in
+    {source, target, head}, derived from raw state data."""
+    prev = spec.get_previous_epoch(state)
+    if is_post_altair(state):
+        flag_index = {
+            "source": spec.TIMELY_SOURCE_FLAG_INDEX,
+            "target": spec.TIMELY_TARGET_FLAG_INDEX,
+            "head": spec.TIMELY_HEAD_FLAG_INDEX,
+        }[duty]
+        return set(
+            int(i) for i in spec.get_unslashed_participating_indices(state, flag_index, prev))
+    atts = {
+        "source": spec.get_matching_source_attestations,
+        "target": spec.get_matching_target_attestations,
+        "head": spec.get_matching_head_attestations,
+    }[duty](state, prev)
+    return set(int(i) for i in spec.get_unslashed_attesting_indices(state, atts))
+
+
+# --- component invariant validation ------------------------------------------
+
+
+def validate_attestation_component(spec, state, duty: str, deltas: Deltas) -> None:
+    """source/target/head: participants are never penalized; non-participating
+    eligible validators earn nothing and are penalized; the ineligible get
+    zero/zero. Under a leak, even participants earn no attestation rewards
+    (altair semantics; phase0 pays a leak-reduced amount through different
+    arithmetic — the zero-reward claim is altair-only)."""
+    n = len(state.validators)
+    assert len(deltas.rewards) == n and len(deltas.penalties) == n
+    eligible = eligible_indices(spec, state)
+    participants = duty_participants(spec, state, duty)
+    leaking = spec.is_in_inactivity_leak(state)
+    post_altair = is_post_altair(state)
+    # altair exempts the head flag from penalties (head timeliness is hard
+    # to control for honest validators); phase0 penalizes all three duties
+    penalizes = not (post_altair and duty == "head")
+    for i in range(n):
+        r, p = int(deltas.rewards[i]), int(deltas.penalties[i])
+        if i not in eligible:
+            assert r == 0 and p == 0, f"{duty}: ineligible {i} has deltas"
+        elif i in participants:
+            assert p == 0, f"{duty}: participant {i} penalized"
+            if leaking and post_altair:
+                assert r == 0, f"{duty}: leak paid attestation reward to {i}"
+        else:
+            assert r == 0, f"{duty}: non-participant {i} rewarded"
+            if penalizes:
+                assert p > 0, f"{duty}: non-participant {i} not penalized"
+            else:
+                assert p == 0, f"{duty}: altair head flag must not penalize {i}"
+    # liveness of the component itself: outside a leak (where altair zeroes
+    # attestation rewards), a non-empty participant set must actually earn —
+    # otherwise a regression zeroing the reward arithmetic passes silently
+    if participants and not leaking:
+        total = sum(int(deltas.rewards[i]) for i in participants)
+        assert total > 0, f"{duty}: participants earned nothing outside a leak"
+
+
+def validate_inclusion_delay_component(spec, state, deltas: Deltas) -> None:
+    """phase0 only: nobody is penalized; source-credited attesters earn."""
+    n = len(state.validators)
+    participants = duty_participants(spec, state, "source")
+    for i in range(n):
+        assert int(deltas.penalties[i]) == 0, f"inclusion_delay penalized {i}"
+        if int(deltas.rewards[i]) > 0:
+            # rewards go to attesters and to their including proposers —
+            # proposers may be outside the attester set, so only the converse
+            # direction is checkable per-index:
+            pass
+    for i in participants:
+        assert int(deltas.rewards[i]) > 0, f"attester {i} got no inclusion reward"
+
+
+def validate_inactivity_component(spec, state, deltas: Deltas) -> None:
+    """Inactivity: never rewards anyone. Penalties hit eligible validators
+    missing target participation — always in altair (score-scaled), only
+    under leak in phase0."""
+    n = len(state.validators)
+    eligible = eligible_indices(spec, state)
+    target_participants = duty_participants(spec, state, "target")
+    leaking = spec.is_in_inactivity_leak(state)
+    post_altair = is_post_altair(state)
+    for i in range(n):
+        r, p = int(deltas.rewards[i]), int(deltas.penalties[i])
+        assert r == 0, f"inactivity rewarded {i}"
+        if i not in eligible:
+            assert p == 0, f"inactivity penalized ineligible {i}"
+            continue
+        if post_altair:
+            score = int(state.inactivity_scores[i])
+            if i in target_participants or score == 0:
+                assert p == 0, f"inactivity penalized participant/zero-score {i}"
+            elif score > 0:
+                assert p > 0, f"score {score} but no inactivity penalty for {i}"
+        else:
+            if not leaking:
+                assert p == 0, f"phase0 inactivity penalty outside leak for {i}"
+            else:
+                # phase0 leak: EVERY eligible validator pays the flat
+                # base-reward component; non-target-participants additionally
+                # pay the quadratic finality-delay term
+                assert p > 0, f"phase0 leak: eligible {i} unpenalized"
+
+
+# --- the harness -------------------------------------------------------------
+
+
+def component_deltas(spec, state):
+    """(name, Deltas) per fork-appropriate component."""
+    if is_post_altair(state):
+        for name, idx in (
+            ("source_deltas", spec.TIMELY_SOURCE_FLAG_INDEX),
+            ("target_deltas", spec.TIMELY_TARGET_FLAG_INDEX),
+            ("head_deltas", spec.TIMELY_HEAD_FLAG_INDEX),
+        ):
+            yield name, make_deltas(spec.get_flag_index_deltas(state, idx))
+    else:
+        yield "source_deltas", make_deltas(spec.get_source_deltas(state))
+        yield "target_deltas", make_deltas(spec.get_target_deltas(state))
+        yield "head_deltas", make_deltas(spec.get_head_deltas(state))
+        yield "inclusion_delay_deltas", make_deltas(spec.get_inclusion_delay_deltas(state))
+    yield "inactivity_penalty_deltas", make_deltas(spec.get_inactivity_penalty_deltas(state))
+
+
+def validate_component(spec, state, name: str, deltas: Deltas) -> None:
+    if name in ("source_deltas", "target_deltas", "head_deltas"):
+        validate_attestation_component(spec, state, name.split("_")[0], deltas)
+    elif name == "inclusion_delay_deltas":
+        validate_inclusion_delay_component(spec, state, deltas)
+    else:
+        validate_inactivity_component(spec, state, deltas)
+
+
+def check_total_consistency(spec, state, components: dict) -> None:
+    """Sum of per-component deltas == balance movement of the real
+    process_rewards_and_penalties sweep (run on a copy). This pins the
+    isolation decomposition to the actual epoch transition."""
+    probe = state.copy()
+    spec.process_rewards_and_penalties(probe)
+    n = len(state.validators)
+    for i in range(n):
+        total = sum(int(d.rewards[i]) for d in components.values()) - sum(
+            int(d.penalties[i]) for d in components.values())
+        expected = int(probe.balances[i]) - int(state.balances[i])
+        # balances floor at zero: a penalty overshoot saturates
+        if expected == -int(state.balances[i]) and total < expected:
+            continue
+        assert total == expected, (
+            f"component sum {total} != epoch-processing movement {expected} "
+            f"for validator {i}")
+
+
+def run_deltas(spec, state):
+    """Vector-part generator: pre + every component (validated), plus the
+    total-consistency check. Use from @spec_state_test bodies."""
+    yield "pre", state.copy()
+    components = {}
+    for name, deltas in component_deltas(spec, state):
+        validate_component(spec, state, name, deltas)
+        components[name] = deltas
+        yield name, deltas
+    check_total_consistency(spec, state, components)
