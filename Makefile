@@ -10,20 +10,26 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding
 
-.PHONY: test citest testfast lint pyspec generate_tests clean_vectors \
+.PHONY: test testall citest testfast lint pyspec generate_tests clean_vectors \
         detect_generator_incomplete bench graft_check native replay \
         random_codegen
 
 # Default developer loop: full suite (minimal preset, BLS stubbed where the
 # suite chooses; JAX pinned to the virtual 8-device CPU mesh by tests/conftest.py).
 test:
-	$(PYTHON) -m pytest tests/ -x -q
+	$(PYTHON) -m pytest tests/ -x -q -m "not slow"
 
-# CI profile: verbose, no -x, junit output.
+# Everything, including the multi-minute compile-bound crypto tests the
+# default lane defers (reference Makefile:98-100 keeps a fast-minimal
+# default too; nothing is deleted — this lane runs it all).
+testall:
+	$(PYTHON) -m pytest tests/ -q
+
+# CI profile: no -x, junit output, ALL tests.
 citest:
 	$(PYTHON) -m pytest tests/ -q --junitxml=test-results/junit.xml
 
-# Quick sanity loop: skip the two multi-minute pairing tests.
+# Quick sanity loop: skip every device-pairing test.
 testfast:
 	$(PYTHON) -m pytest tests/ -x -q -k "not pairing"
 

@@ -134,13 +134,13 @@ def bench_bls() -> tuple[float, float, float]:
     from consensus_specs_tpu.crypto.bls_jax import random_zbits
 
     zbits = random_zbits(N_BLS)
-    ok = K.pairing_check_rlc(*args, zbits)
+    ok = K.pairing_check_rlc(*args, zbits, p2_is_neg_g1=True)
     ok.block_until_ready()
     assert bool(np.asarray(ok))
     rlc_times = []
     for _ in range(3):
         t0 = _time.time()
-        K.pairing_check_rlc(*args, zbits).block_until_ready()
+        K.pairing_check_rlc(*args, zbits, p2_is_neg_g1=True).block_until_ready()
         rlc_times.append(_time.time() - t0)
     return per_item, N_BLS / min(rlc_times), compile_s
 
@@ -163,6 +163,14 @@ def run_benches() -> dict:
             import benches.attestation_bench as att_bench
 
             att_per_s, att_epoch_s, att_count = att_bench.run()
+        with timed("bench_state_root"):
+            import benches.state_root_bench as sr_bench
+
+            sr = sr_bench.run(int(os.environ.get("BENCH_SR_VALIDATORS", N_VALIDATORS)))
+        with timed("bench_epoch_e2e"):
+            import benches.epoch_e2e_bench as e2e_bench
+
+            e2e = e2e_bench.run(int(os.environ.get("BENCH_E2E_VALIDATORS", N_VALIDATORS)))
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -181,6 +189,15 @@ def run_benches() -> dict:
             "attestation_epoch_s": round(att_epoch_s, 4),
             "attestations_per_epoch": att_count,
             "attestation_validators": att_bench.default_validators(),
+            # BASELINE config 4 honest end-to-end: bridge + device epoch +
+            # write-back + state root (vs the engine-only number above)
+            "epoch_e2e_s": e2e["e2e_epoch_s"],
+            "epoch_e2e_stages_s": e2e["stages_s"],
+            "epoch_e2e_validators": e2e["validators"],
+            # per-slot state root at registry scale (incremental Merkle)
+            "state_root_slot_s": sr["slot_root_s"],
+            "state_root_block_s": sr["block_root_s"],
+            "state_root_cold_s": sr["cold_root_s"],
             "device": str(jax.devices()[0]),
         },
     }

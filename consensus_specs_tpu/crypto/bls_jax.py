@@ -161,8 +161,13 @@ def _device_check_all(p1s, q1s, p2s, q2s) -> bool:
 
     from ..ops import bls12_jax as K
 
+    # every queued check's second pairing is e(−G1, sig) (QueuedCheck
+    # construction above) — the fixed-base window path applies; the assert
+    # pins the invariant so a future check kind with a different base fails
+    # loudly instead of silently verifying the wrong equation
+    assert all(p2 is _NEG_G1 for p2 in p2s), "RLC fast path requires p2 == -G1"
     b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
-    ok = K.pairing_check_rlc(*args, random_zbits(b))
+    ok = K.pairing_check_rlc(*args, random_zbits(b), p2_is_neg_g1=True)
     return bool(np.asarray(jax.device_get(ok)))
 
 

@@ -94,7 +94,8 @@ def state_to_device_with_columns(spec, state):
     return dev, cfg, cols
 
 
-def _write_back(spec, state, dev: EpochState, pre_cols: dict) -> None:
+def _write_back(spec, state, dev: EpochState, pre_cols: dict,
+                pre_mixes: np.ndarray | None = None) -> None:
     # Registry fields: diff against the pre-epoch columns and touch only the
     # validators a sub-transition actually mutated (activation churn,
     # hysteresis, ejections — typically a small fraction of the registry).
@@ -113,18 +114,22 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict) -> None:
         for i, value in zip(changed.tolist(), values):
             setattr(vals[i], name, typ(value))
     # Whole-registry vectors: bulk one-pass reconstruction.
-    state.balances = type(state.balances).from_values(
-        np.asarray(dev.balances).tolist())
-    state.inactivity_scores = type(state.inactivity_scores).from_values(
-        np.asarray(dev.inactivity_scores).tolist())
-    state.previous_epoch_participation = type(state.previous_epoch_participation).from_values(
-        np.asarray(dev.prev_participation).tolist())
-    state.current_epoch_participation = type(state.current_epoch_participation).from_values(
-        np.asarray(dev.curr_participation).tolist())
-    state.slashings = type(state.slashings).from_values(
-        np.asarray(dev.slashings).tolist())
+    state.balances = type(state.balances).from_numpy(np.asarray(dev.balances))
+    state.inactivity_scores = type(state.inactivity_scores).from_numpy(
+        np.asarray(dev.inactivity_scores))
+    state.previous_epoch_participation = type(state.previous_epoch_participation).from_numpy(
+        np.asarray(dev.prev_participation))
+    state.current_epoch_participation = type(state.current_epoch_participation).from_numpy(
+        np.asarray(dev.curr_participation))
+    state.slashings = type(state.slashings).from_numpy(np.asarray(dev.slashings))
     mixes = np.asarray(dev.randao_mixes)
-    for i in range(mixes.shape[0]):
+    if pre_mixes is not None:
+        # epoch processing touches at most one mix slot; diff and write only
+        # the changed rows (65536 Bytes32 writes -> ~1)
+        changed_rows = np.nonzero((mixes != pre_mixes).any(axis=1))[0].tolist()
+    else:
+        changed_rows = range(mixes.shape[0])
+    for i in changed_rows:
         state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
     for i, b in enumerate(np.asarray(dev.justification_bits)):
         state.justification_bits[i] = bool(b)
@@ -168,11 +173,24 @@ def _rotate_sync_committees(spec, state) -> None:
     )
 
 
-def apply_epoch_via_engine(spec, state) -> None:
-    """Mutating `process_epoch` replacement running the device engine."""
+def apply_epoch_via_engine(spec, state, stage_timer=None) -> None:
+    """Mutating `process_epoch` replacement running the device engine.
+
+    `stage_timer(name)`: optional callable invoked after each stage —
+    bridge_in / device / write_back — so benchmarks (benches/
+    epoch_e2e_bench.py) time the REAL pipeline instead of re-implementing
+    it."""
+    import jax
+
+    tick = stage_timer or (lambda name: None)
     dev, cfg, pre_cols = state_to_device_with_columns(spec, state)
+    pre_mixes = np.asarray(dev.randao_mixes)
+    tick("bridge_in")
     dev_out, aux = epoch_fn_for(cfg)(dev)
-    _write_back(spec, state, dev_out, pre_cols)
+    if stage_timer is not None:
+        jax.block_until_ready(dev_out.balances)
+    tick("device")
+    _write_back(spec, state, dev_out, pre_cols, pre_mixes)
     if bool(aux.eth1_votes_reset):
         state.eth1_data_votes = type(state.eth1_data_votes)()
     if bool(aux.historical_append):
@@ -183,3 +201,4 @@ def apply_epoch_via_engine(spec, state) -> None:
         )
     if bool(aux.sync_committee_update):
         _rotate_sync_committees(spec, state)
+    tick("write_back")

@@ -840,6 +840,30 @@ class _Sequence(SSZType):
         out._attach_all()  # no-op for basic elems; REQUIRED for tracked ones
         return out
 
+    @classmethod
+    def from_numpy(cls, arr):
+        """from_values + merkle-tree pre-seeding straight from the column's
+        bytes: the registry-scale write-back (engine/bridge) replaces whole
+        basic-element lists per epoch, and packing chunks from the numpy
+        buffer skips the million-call per-element encode pass the first
+        hash_tree_root would otherwise pay."""
+        import numpy as np
+
+        et = cls.ELEM_TYPE
+        if not _is_basic(et):
+            raise TypeError("from_numpy: basic element types only")
+        size = et.type_byte_length()
+        arr = np.ascontiguousarray(arr)
+        out = cls.from_values(arr.tolist())
+        blob = arr.astype(f"<u{size}", copy=False).tobytes()
+        if len(blob) % BYTES_PER_CHUNK:
+            blob += b"\x00" * (BYTES_PER_CHUNK - len(blob) % BYTES_PER_CHUNK)
+        if len(blob) // BYTES_PER_CHUNK >= _TREE_MIN_CHUNKS:
+            limit = out.chunk_limit() if hasattr(out, "chunk_limit") else out.chunk_count()
+            object.__setattr__(out, "_tree", IncrementalTree(blob, limit))
+            object.__setattr__(out, "_structural", False)
+        return out
+
     # --- shared serialization over self._elems ---
 
     def encode_bytes(self) -> bytes:

@@ -117,7 +117,7 @@ def exit_fraction(spec, state, fraction: float) -> None:
         v.withdrawable_epoch = v.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
 
 
-def put_in_leak(spec, state, extra_epochs: int = 3) -> None:
+def put_in_leak(spec, state, extra_epochs: int = 0) -> None:
     """Advance far enough past the (never-updated) finalized checkpoint that
     is_in_inactivity_leak flips on."""
     target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 1 + extra_epochs

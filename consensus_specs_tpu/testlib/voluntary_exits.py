@@ -18,7 +18,29 @@ def build_voluntary_exit(spec, state, index, epoch=None):
     )
 
 
+_aged_cache: dict = {}
+
+
 def age_state_past_shard_committee_period(spec, state):
-    """Advance so validators satisfy the exit-eligibility age gate."""
-    epochs = int(spec.config.SHARD_COMMITTEE_PERIOD)
-    spec.process_slots(state, state.slot + epochs * spec.SLOTS_PER_EPOCH)
+    """Advance so validators satisfy the exit-eligibility age gate.
+
+    The SHARD_COMMITTEE_PERIOD-epoch advance is deterministic per starting
+    state, so it runs once per (fork, preset, pre-root) and later callers
+    get the cached result copied in — every voluntary-exit test was paying
+    ~10s of identical epoch transitions (VERDICT r2 item 7)."""
+    from ..ssz import hash_tree_root
+
+    # config must join the key: with_config_overrides builds specs sharing
+    # fork/preset whose SHARD_COMMITTEE_PERIOD (and thus aging depth) differs
+    # while the pre-state root is identical
+    key = (spec.fork, spec.preset_name,
+           int(spec.config.SHARD_COMMITTEE_PERIOD), bytes(hash_tree_root(state)))
+    aged = _aged_cache.get(key)
+    if aged is None:
+        epochs = int(spec.config.SHARD_COMMITTEE_PERIOD)
+        spec.process_slots(state, state.slot + epochs * spec.SLOTS_PER_EPOCH)
+        _aged_cache[key] = state.copy()
+        return
+    fresh = aged.copy()
+    for name in state.fields():
+        setattr(state, name, getattr(fresh, name))

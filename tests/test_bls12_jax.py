@@ -96,6 +96,7 @@ def _pairing_inputs(k1: int, k2: int):
     return p1, q1
 
 
+@pytest.mark.slow
 def test_pairing_matches_oracle():
     # the device final exp computes the CUBE of the canonical pairing
     p1, q1 = _pairing_inputs(5, 7)
@@ -108,6 +109,7 @@ def test_pairing_matches_oracle():
     assert got == want
 
 
+@pytest.mark.slow
 def test_pairing_check_bilinear():
     # e([a]G1, G2) · e(-G1, [a]G2) == 1
     a = 11
@@ -155,6 +157,7 @@ def test_g1_add_reduce(backend):
     assert got == want
 
 
+@pytest.mark.slow
 def test_pairing_check_limb_backend_pairing():
     """End-to-end pairing on the positional-limb backend (the CPU-oriented
     path): e([a]G1, G2)·e(-G1, [a]G2) == 1 and a corrupted pair fails. Keeps
@@ -189,6 +192,7 @@ def test_pairing_check_limb_backend_pairing():
         K.set_field_backend("rns")
 
 
+@pytest.mark.slow
 def test_cyclotomic_sqr_matches_generic_pairing():
     """f12_cyclotomic_sqr == f12_mul(f, f) on a unitary element (a reduced
     pairing value is in G_T, hence unitary) — the differential check the
@@ -202,6 +206,7 @@ def test_cyclotomic_sqr_matches_generic_pairing():
     assert got == want
 
 
+@pytest.mark.slow
 def test_pairing_check_rlc_pairing():
     """Shared-final-exp randomized batch check: all-valid passes, one bad
     item fails, on a 4-item batch (RNS backend)."""

@@ -115,6 +115,7 @@ def test_deferred_state_transition_matches_inline_pairing():
             spec.state_transition(state_c, bad)
 
 
+@pytest.mark.slow
 def test_device_pubkey_aggregation_matches_oracle_pairing():
     """AggregatePKs via the device G1 reduction tree == host oracle."""
     from consensus_specs_tpu.crypto.bls_jax import aggregate_pubkeys_device
@@ -179,6 +180,7 @@ def test_default_state_transition_one_launch_pairing(monkeypatch):
         f"expected 1 device pairing launch per block, saw {launches['n']}")
 
 
+@pytest.mark.slow
 def test_deferred_large_batch_rlc_path_pairing():
     """A >=16-item deferred flush takes the shared-final-exp randomized path;
     a corrupted batch falls back to per-item attribution and still raises."""

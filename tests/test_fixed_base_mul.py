@@ -1,0 +1,66 @@
+"""Fixed-base window multiplication for −G1 (the RLC fast path's constant
+base) differentially against the generic double-and-add ladder and the
+host oracle. Fast: G1-only kernels, no pairing compile."""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls12_381 as oracle
+from consensus_specs_tpu.crypto.bls_jax import random_zbits
+from consensus_specs_tpu.ops import bls12_jax as K
+
+
+def _zbits_for(zs):
+    import jax.numpy as jnp
+
+    return jnp.asarray(
+        np.array([[(z >> i) & 1 for i in range(64)] for z in zs], dtype=bool))
+
+
+def _to_affine_ints(pt):
+    ax, ay = K._g1_jacobian_to_affine_batch(pt)
+    return (
+        [K.F.from_mont_int(np.asarray(ax[i])) for i in range(ax.shape[0])],
+        [K.F.from_mont_int(np.asarray(ay[i])) for i in range(ay.shape[0])],
+    )
+
+
+def test_fixed_base_matches_ladder_and_oracle():
+    zs = [1, 2, 255, 256, 257, 0xFFFF_FFFF_FFFF_FFFF, 0x0123_4567_89AB_CDEF,
+          1 << 63, (1 << 64) - 2]
+    zbits = _zbits_for(zs)
+    fixed = K.g1_fixed_mul_neg_g1(zbits)
+
+    gx, gy = oracle.G1_GEN_AFF
+    neg = (gx, (-gy) % oracle.P)
+    enc = K.F.ints_to_mont_batch
+    px = np.tile(enc([neg[0]]), (len(zs), 1))
+    py = np.tile(enc([neg[1]]), (len(zs), 1))
+    import jax.numpy as jnp
+
+    one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), px.shape)
+    ladder = K.g1_scalar_mul_batch((jnp.asarray(px), jnp.asarray(py), one), zbits)
+
+    fx, fy = _to_affine_ints(fixed)
+    lx, ly = _to_affine_ints(ladder)
+    assert fx == lx and fy == ly, "fixed-base disagrees with ladder"
+
+    neg_jac = oracle.pt_from_affine(oracle.FP_FIELD, neg)
+    for i, z in enumerate(zs):
+        want = oracle.pt_to_affine(
+            oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, neg_jac, z))
+        assert (fx[i], fy[i]) == want, f"oracle mismatch at z={z:#x}"
+
+
+def test_fixed_base_random_batch():
+    zbits = random_zbits(32)
+    fixed = K.g1_fixed_mul_neg_g1(zbits)
+    # spot-check three random entries against the oracle
+    bits = np.asarray(zbits)
+    gx, gy = oracle.G1_GEN_AFF
+    neg_jac = oracle.pt_from_affine(oracle.FP_FIELD, (gx, (-gy) % oracle.P))
+    fx, fy = _to_affine_ints(fixed)
+    for i in (0, 13, 31):
+        z = sum(int(b) << k for k, b in enumerate(bits[i]))
+        want = oracle.pt_to_affine(
+            oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, neg_jac, z))
+        assert (fx[i], fy[i]) == want

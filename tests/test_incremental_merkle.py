@@ -305,6 +305,30 @@ def test_parent_links_deduplicate():
     assert holder.hash_tree_root() == fresh_root(holder)
 
 
+def test_from_numpy_tree_seeding_matches():
+    """from_numpy's pre-seeded tree must produce the identical root the
+    per-element path computes, for every basic dtype the bridge uses."""
+    import numpy as np
+
+    L64 = List[uint64, 1 << 40]
+    arr = np.arange(1000, 3100, dtype=np.uint64)
+    a, b = L64.from_numpy(arr), L64.from_values(arr.tolist())
+    assert a.hash_tree_root() == b.hash_tree_root() == fresh_root(a)
+    # mutation after seeding stays incremental and correct
+    a[7] = 42
+    assert a.hash_tree_root() == fresh_root(a)
+
+    L8 = List[uint8, 1 << 40]
+    arr8 = (np.arange(5000) % 8).astype(np.uint8)
+    assert (L8.from_numpy(arr8).hash_tree_root()
+            == L8.from_values(arr8.tolist()).hash_tree_root())
+
+    V64 = Vector[uint64, 512]
+    arrv = np.arange(512, dtype=np.uint64)
+    assert (V64.from_numpy(arrv).hash_tree_root()
+            == V64.from_values(arrv.tolist()).hash_tree_root())
+
+
 def test_per_slot_cost_drops():
     """The point of the exercise: after one full hash, a single-field write
     rehashes a path, not the world — measured as a strict time ratio."""
