@@ -59,15 +59,33 @@ def _advance_to_unslashed_proposer(spec, state):
         probe = state.copy()
         spec.process_slots(probe, probe.slot + 1)
         if not probe.validators[spec.get_beacon_proposer_index(probe)].slashed:
-            return
+            return probe  # state advanced to the block's slot — reusable
         next_slots(spec, state, 1)
     raise AssertionError("no unslashed proposer found in two epochs")
 
 
-def random_block(spec, state, rng: Random):
-    """An empty-ish block with a random sprinkle of valid attestations."""
-    _advance_to_unslashed_proposer(spec, state)
+def random_block(spec, state, rng: Random, with_ops: bool = False):
+    """An empty-ish block with a random sprinkle of valid attestations and
+    (with_ops) a random subset of other operations: deposits, proposer/
+    attester slashings, and randomized sync-aggregate participation — the
+    reference's randomized_block_tests block vocabulary
+    (random_block_altair :180-220)."""
+    deposit = None
+    if with_ops and rng.random() < 0.5:
+        # top-up deposit for an existing validator — built BEFORE the block
+        # skeleton AND before the proposer probe: it installs a new
+        # eth1_data deposit root/count on the state, which changes the
+        # state root both the parent-header prediction and the probe's
+        # block-root chain must capture
+        from .deposits import build_deposit_for_index
+
+        idx = rng.randrange(len(state.validators))
+        amount = spec.Gwei(rng.randrange(1, int(spec.MAX_EFFECTIVE_BALANCE)))
+        deposit = build_deposit_for_index(spec, state, idx, amount=amount)
+    probe = _advance_to_unslashed_proposer(spec, state)
     block = build_empty_block_for_next_slot(spec, state)
+    if deposit is not None:
+        block.body.deposits.append(deposit)
     if int(state.slot) > int(spec.MIN_ATTESTATION_INCLUSION_DELAY):
         target = int(state.slot) - int(spec.MIN_ATTESTATION_INCLUSION_DELAY)
         for _ in range(rng.randrange(0, 2)):
@@ -76,15 +94,62 @@ def random_block(spec, state, rng: Random):
                 block.body.attestations.append(att)
             except Exception:
                 break
+    if with_ops:
+        slashed_in_block: set = set()
+        if rng.random() < 0.4:
+            from .slashings import build_proposer_slashing
+
+            try:
+                target_idx = _random_slashable_index(spec, state, rng)
+                if target_idx is not None:
+                    block.body.proposer_slashings.append(
+                        build_proposer_slashing(spec, state, proposer_index=target_idx))
+                    slashed_in_block.add(int(target_idx))
+            except Exception:
+                pass
+        if rng.random() < 0.3:
+            from .slashings import build_attester_slashing
+
+            try:
+                slashing = build_attester_slashing(spec, state)
+                # viable only if someone remains slashABLE after the earlier
+                # proposer slashing of this same block is applied
+                # (process_operations handles proposer slashings first)
+                if any(not state.validators[i].slashed
+                       and int(i) not in slashed_in_block
+                       for i in slashing.attestation_1.attesting_indices):
+                    block.body.attester_slashings.append(slashing)
+            except Exception:
+                pass
+        if hasattr(block.body, "sync_aggregate") and rng.random() < 0.6:
+            from .sync_committee import build_sync_aggregate
+
+            bits = [rng.random() < 0.8 for _ in range(int(spec.SYNC_COMMITTEE_SIZE))]
+            try:
+                # `probe` is already advanced to block.slot (proposer hunt)
+                block.body.sync_aggregate = build_sync_aggregate(spec, probe, bits)
+            except Exception:
+                pass
     return block
 
 
+def _random_slashable_index(spec, state, rng: Random):
+    """A random index that is currently slashable (active, not slashed)."""
+    epoch = spec.get_current_epoch(state)
+    candidates = [
+        i for i, v in enumerate(state.validators)
+        if spec.is_slashable_validator(v, epoch)
+    ]
+    return rng.choice(candidates) if candidates else None
+
+
 def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2,
-                        epoch_boundary=False):
+                        epoch_boundary=False, ops=False):
     """One composed scenario; yields the sanity-blocks vector parts.
 
     epoch_boundary: hop to the last slot of the epoch before the final block
-    so it crosses process_epoch with the randomized registry."""
+    so it crosses process_epoch with the randomized registry.
+    ops: blocks carry random deposits/slashings/sync participation too."""
     rng = Random(seed)
     randomize_state(spec, state, rng)
     if leak:
@@ -99,7 +164,7 @@ def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2,
             to_boundary = per_epoch - 1 - (int(state.slot) % per_epoch)
             if to_boundary:
                 next_slots(spec, state, to_boundary)
-        block = random_block(spec, state, rng)
+        block = random_block(spec, state, rng, with_ops=ops)
         signed.append(state_transition_and_sign_block(spec, state, block))
     yield "meta", "meta", {"blocks_count": len(signed)}
     for i, s in enumerate(signed):

@@ -177,3 +177,161 @@ def test_compute_subnets_for_sync_committee(aspec):
 def test_is_sync_committee_aggregator_deterministic(aspec):
     sig = b"\x07" * 96
     assert aspec.is_sync_committee_aggregator(sig) == aspec.is_sync_committee_aggregator(sig)
+
+
+# --- eth1 voting scenario matrix (reference test_validator_unittest.py's
+# get_eth1_vote default/consensus/tie/chain-in-past cases, re-derived) ------
+
+
+def _voting_setup(spec, state):
+    st = state.copy()
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    next_slots(spec, st, period - int(st.slot) % period)
+    period_start = int(spec.voting_period_start_time(st))
+    follow = int(spec.config.SECONDS_PER_ETH1_BLOCK) * int(spec.config.ETH1_FOLLOW_DISTANCE)
+    return st, period_start, follow
+
+
+def _eth1_block(spec, st, timestamp, tag, count=None):
+    return spec.Eth1Block(
+        timestamp=timestamp,
+        deposit_root=spec.Root(bytes([tag]) * 32),
+        deposit_count=st.eth1_data.deposit_count if count is None else count,
+    )
+
+
+def test_eth1_vote_no_candidates_defaults_to_state(spec, state):
+    """Empty/out-of-window chain: the safe default is the current eth1_data."""
+    st, period_start, follow = _voting_setup(spec, state)
+    assert spec.get_eth1_vote(st, []) == st.eth1_data
+    # a chain entirely too RECENT (inside the follow distance) also defaults
+    recent = [_eth1_block(spec, st, period_start - 1 - i, i) for i in range(3)]
+    assert spec.get_eth1_vote(st, recent) == st.eth1_data
+
+
+def test_eth1_vote_default_is_latest_candidate(spec, state):
+    """With candidates but no prior votes, the vote is the newest in-window
+    block's data."""
+    st, period_start, follow = _voting_setup(spec, state)
+    chain = [  # ascending height == ascending timestamp
+        _eth1_block(spec, st, period_start - 2 * follow + i * 10, i)
+        for i in range(5)
+    ]
+    in_window = [b for b in chain
+                 if spec.is_candidate_block(b, spec.uint64(period_start))]
+    assert in_window, "setup bug: no candidate blocks"
+    assert spec.get_eth1_vote(st, chain) == spec.get_eth1_data(in_window[-1])
+
+
+def test_eth1_vote_tiebreak_prefers_earlier_vote(spec, state):
+    """Equal counts: the tie-break favors the candidate voted FIRST."""
+    st, period_start, follow = _voting_setup(spec, state)
+    chain = [_eth1_block(spec, st, period_start - follow - 10 - i, i) for i in range(2)]
+    a, b = spec.get_eth1_data(chain[0]), spec.get_eth1_data(chain[1])
+    st.eth1_data_votes.append(b)
+    st.eth1_data_votes.append(a)
+    st.eth1_data_votes.append(a)
+    st.eth1_data_votes.append(b)
+    assert spec.get_eth1_vote(st, chain) == b  # 2-2, b was cast first
+
+
+def test_eth1_vote_ignores_deposit_count_rollback(spec, state):
+    """Candidates with a LOWER deposit count than the state's are never
+    eligible (monotonicity guard), even with majority votes."""
+    st, period_start, follow = _voting_setup(spec, state)
+    st.eth1_data.deposit_count = 10
+    rollback = _eth1_block(spec, st, period_start - follow - 5, 7, count=3)
+    ok = _eth1_block(spec, st, period_start - follow - 6, 8, count=12)
+    for _ in range(5):
+        st.eth1_data_votes.append(spec.get_eth1_data(rollback))
+    assert spec.get_eth1_vote(st, [rollback, ok]) == spec.get_eth1_data(ok)
+
+
+def test_is_candidate_block_window_edges(spec, state):
+    st, period_start, follow = _voting_setup(spec, state)
+    ps = spec.uint64(period_start)
+    assert spec.is_candidate_block(_eth1_block(spec, st, period_start - follow, 1), ps)
+    assert spec.is_candidate_block(_eth1_block(spec, st, period_start - 2 * follow, 2), ps)
+    assert not spec.is_candidate_block(
+        _eth1_block(spec, st, period_start - follow + 1, 3), ps)
+    assert not spec.is_candidate_block(
+        _eth1_block(spec, st, period_start - 2 * follow - 1, 4), ps)
+
+
+# --- signature constructions (real BLS: each helper's output must verify
+# under its domain against the signer's registry pubkey) ---------------------
+
+
+@pytest.fixture()
+def real_bls():
+    prev = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev
+
+
+def _signer(spec, state, index=0):
+    from consensus_specs_tpu.testlib.keys import privkeys
+
+    return privkeys[index], state.validators[index].pubkey
+
+
+def test_get_epoch_signature_verifies(real_bls, spec, state):
+    from consensus_specs_tpu.testlib.block import build_empty_block_for_next_slot
+
+    st = state.copy()
+    block = build_empty_block_for_next_slot(spec, st)
+    idx = int(block.proposer_index)
+    privkey, pubkey = _signer(spec, st, idx)
+    sig = spec.get_epoch_signature(st, block, privkey)
+    epoch = spec.compute_epoch_at_slot(block.slot)
+    domain = spec.get_domain(st, spec.DOMAIN_RANDAO, epoch)
+    root = spec.compute_signing_root(epoch, domain)
+    assert bls.Verify(pubkey, root, sig)
+
+
+def test_get_block_signature_verifies(real_bls, spec, state):
+    from consensus_specs_tpu.testlib.block import build_empty_block_for_next_slot
+
+    st = state.copy()
+    block = build_empty_block_for_next_slot(spec, st)
+    idx = int(block.proposer_index)
+    privkey, pubkey = _signer(spec, st, idx)
+    sig = spec.get_block_signature(st, block, privkey)
+    domain = spec.get_domain(
+        st, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot))
+    assert bls.Verify(pubkey, spec.compute_signing_root(block, domain), sig)
+
+
+def test_slot_and_attestation_signatures_verify(real_bls, spec, state):
+    st = state.copy()
+    privkey, pubkey = _signer(spec, st, 5)
+    slot = st.slot
+    sig = spec.get_slot_signature(st, slot, privkey)
+    domain = spec.get_domain(
+        st, spec.DOMAIN_SELECTION_PROOF, spec.compute_epoch_at_slot(slot))
+    assert bls.Verify(pubkey, spec.compute_signing_root(slot, domain), sig)
+
+    data = spec.AttestationData(
+        slot=slot, index=0,
+        source=st.current_justified_checkpoint,
+        target=spec.Checkpoint(epoch=spec.get_current_epoch(st)))
+    att_sig = spec.get_attestation_signature(st, data, privkey)
+    att_domain = spec.get_domain(st, spec.DOMAIN_BEACON_ATTESTER, data.target.epoch)
+    assert bls.Verify(pubkey, spec.compute_signing_root(data, att_domain), att_sig)
+
+
+def test_aggregate_and_proof_envelope_verifies(real_bls, spec, state):
+    from consensus_specs_tpu.testlib.attestations import get_valid_attestation
+
+    st = state.copy()
+    att = get_valid_attestation(spec, st, signed=True)
+    committee = spec.get_beacon_committee(st, att.data.slot, att.data.index)
+    agg_index = int(committee[0])
+    privkey, pubkey = _signer(spec, st, agg_index)
+    proof = spec.get_aggregate_and_proof(st, spec.ValidatorIndex(agg_index), att, privkey)
+    assert proof.selection_proof == spec.get_slot_signature(st, att.data.slot, privkey)
+    env_sig = spec.get_aggregate_and_proof_signature(st, proof, privkey)
+    domain = spec.get_domain(
+        st, spec.DOMAIN_AGGREGATE_AND_PROOF, spec.compute_epoch_at_slot(att.data.slot))
+    assert bls.Verify(pubkey, spec.compute_signing_root(proof, domain), env_sig)
