@@ -46,6 +46,7 @@ pyspec:
 # flake8+mypy role (linter.ini) — those tools are not in this image.
 lint: pyspec
 	$(PYTHON) tools/lint.py
+	$(PYTHON) tools/typegate.py
 
 # Regenerate the checked-in randomized test module (reference:
 # tests/generators/random/generate.py workflow).

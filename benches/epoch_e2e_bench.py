@@ -32,8 +32,6 @@ def default_validators() -> int:
 def run(n_validators: int | None = None):
     """Returns dict: e2e_s (median), stage breakdown of the last epoch,
     setup costs."""
-    import jax
-
     from consensus_specs_tpu.compiler import get_spec
     from consensus_specs_tpu.engine import bridge
     from consensus_specs_tpu.ssz import hash_tree_root

@@ -26,8 +26,8 @@ from ..testlib.context import (
     with_all_phases,
     with_phases,
 )
+from ..testlib.rewards import Deltas as Deltas  # noqa  (re-export for conformance/runner.py)
 from ..testlib.rewards import (
-    Deltas,
     exit_fraction,
     is_post_altair,
     make_deltas as _deltas,  # re-export: conformance/runner.py imports both

@@ -2,7 +2,6 @@
 base) differentially against the generic double-and-add ladder and the
 host oracle. Fast: G1-only kernels, no pairing compile."""
 import numpy as np
-import pytest
 
 from consensus_specs_tpu.crypto import bls12_381 as oracle
 from consensus_specs_tpu.crypto.bls_jax import random_zbits

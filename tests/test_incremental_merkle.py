@@ -13,8 +13,6 @@ Role parity: remerkleable's structural sharing in the reference
 """
 import random
 
-import pytest
-
 from consensus_specs_tpu.ssz.merkle import IncrementalTree, merkleize_chunks, zerohashes
 from consensus_specs_tpu.ssz.types import (
     Bitlist,
@@ -24,7 +22,6 @@ from consensus_specs_tpu.ssz.types import (
     List,
     Union,
     Vector,
-    boolean,
     uint8,
     uint64,
 )

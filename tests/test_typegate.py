@@ -1,0 +1,66 @@
+"""The spec type gate (tools/typegate.py — the reference's mypy-strict
+analog) must pass clean on every fork AND provably detect each defect
+class it claims to cover (a gate that can't fail is not a gate)."""
+import ast
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tools"))
+
+import typegate  # noqa: E402
+
+
+def test_all_forks_clean():
+    for fork in typegate.FORK_ORDER:
+        assert typegate.run_gate(fork) == [], fork
+
+
+def _gate_on(src: str, extra_known=()):
+    tree = ast.parse(src)
+    known = typegate.known_global_names("phase0", {}, tree) | set(extra_known)
+    return (typegate.check_undefined_names(src, known, "t")
+            + typegate.check_call_arity(tree, "t")
+            + typegate.check_annotations(tree, "t"))
+
+
+def test_detects_undefined_name():
+    findings = _gate_on(
+        "def f(x: int) -> int:\n    return x + mystery_constant\n")
+    assert any("T001" in f and "mystery_constant" in f for f in findings)
+
+
+def test_detects_bad_arity():
+    findings = _gate_on(
+        "def f(a: int, b: int) -> int:\n    return a + b\n"
+        "def g() -> int:\n    return f(1, 2, 3)\n")
+    assert any("T002" in f and "3 positional" in f for f in findings)
+    findings = _gate_on(
+        "def f(a: int, b: int) -> int:\n    return a + b\n"
+        "def g() -> int:\n    return f(1)\n")
+    assert any("T002" in f for f in findings)
+
+
+def test_detects_unknown_keyword():
+    findings = _gate_on(
+        "def f(a: int) -> int:\n    return a\n"
+        "def g() -> int:\n    return f(a=1, typo=2)\n")
+    assert any("T002" in f and "typo" in f for f in findings)
+
+
+def test_detects_missing_annotations():
+    findings = _gate_on("def f(x) -> int:\n    return x\n")
+    assert any("T003" in f and "unannotated" in f for f in findings)
+    findings = _gate_on("def f(x: int):\n    return x\n")
+    assert any("T003" in f and "return annotation" in f for f in findings)
+
+
+def test_scoping_no_false_positives():
+    """Comprehension targets, nested defs, and class bodies must not leak
+    false undefined-name findings."""
+    findings = _gate_on(
+        "def f(xs: list) -> list:\n"
+        "    ys = [x * 2 for x in xs]\n"
+        "    def inner(q: int) -> int:\n"
+        "        return q + len(ys)\n"
+        "    return [inner(y) for y in ys]\n")
+    assert findings == []

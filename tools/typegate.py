@@ -1,0 +1,194 @@
+"""Spec type gate: static name/arity/annotation analysis of the executable
+spec markdown.
+
+Reference parity: the mypy-strict pass the reference runs over its GENERATED
+eth2spec modules (/root/reference/linter.ini:5-14, Makefile:133-136 —
+disallow_incomplete_defs etc.). This image ships no mypy, so the gate is
+built from the stdlib: `symtable` resolves real scopes (comprehensions,
+nested defs, class bodies) and `ast` checks call shapes. Three checks over
+every fork's combined spec source:
+
+  T001  undefined name: a global-scope load that resolves to nothing in the
+        overlay namespace (markdown defs, table constants, preset/config
+        keys, compiler runtime, builtins) — the class of typo that otherwise
+        only explodes at runtime on a rarely-taken path
+  T002  bad call arity / unknown keyword for calls to spec-defined functions
+  T003  incomplete def: a spec function with unannotated parameters or
+        return (strict-defs analog; the spec markdown's normative python is
+        fully annotated by construction, so regressions are drift)
+
+Usage: python tools/typegate.py [fork ...]   (default: all forks)
+Exit 1 on any finding. `make typegate` wires it into the lint gate.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.compiler.spec_compiler import (  # noqa: E402
+    FORK_DOCS,
+    FORK_ORDER,
+    SPEC_DIR,
+    _runtime_namespace,
+    load_config,
+    load_preset,
+    parse_spec_markdown,
+)
+
+# names legitimately absent from the static namespace (injected at runtime
+# or intentionally late-bound)
+RUNTIME_INJECTED = {
+    "config",  # frozen Config object, built per (fork, preset)
+    "fork", "preset_name",  # module identity tags
+}
+
+
+def combined_source(fork: str) -> tuple[str, dict]:
+    """All python blocks of the fork overlay concatenated (the exec order),
+    plus the table-constant names."""
+    parts, constants = [], {}
+    forks = FORK_ORDER[: FORK_ORDER.index(fork) + 1]
+    for f in forks:
+        for doc_path in FORK_DOCS[f]:
+            full = SPEC_DIR / doc_path
+            if not full.exists():
+                continue
+            doc = parse_spec_markdown(full.read_text())
+            constants.update(doc.constants)
+            parts.extend(doc.python_blocks)
+    return "\n\n".join(parts), constants
+
+
+def known_global_names(fork: str, constants: dict, tree: ast.Module) -> set:
+    names = set(dir(builtins)) | RUNTIME_INJECTED | set(constants)
+    names |= set(_runtime_namespace().keys())
+    names |= set(load_preset("minimal", FORK_ORDER[: FORK_ORDER.index(fork) + 1]))
+    names |= set(load_config("minimal"))
+    for node in tree.body:  # module-level defs/assignments across the overlay
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.update(e.id for e in t.elts if isinstance(e, ast.Name))
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def check_undefined_names(src: str, known: set, fork: str) -> list[str]:
+    out = []
+    table = symtable.symtable(src, f"<spec:{fork}>", "exec")
+
+    def walk(t: symtable.SymbolTable):
+        for sym in t.get_symbols():
+            if not sym.is_referenced() or sym.get_name() in known:
+                continue
+            # a symbol is suspicious only when nothing binds it anywhere in
+            # this scope (assignment, param, import) and it falls through to
+            # the (already-checked) global namespace
+            if sym.is_assigned() or sym.is_parameter() or sym.is_imported():
+                continue
+            if t.get_type() == "module":
+                bound_here = False
+            else:
+                bound_here = sym.is_local()
+            if not bound_here and sym.is_global():
+                out.append(f"{fork}: T001 undefined name '{sym.get_name()}' "
+                           f"(scope {t.get_name()})")
+        for child in t.get_children():
+            walk(child)
+
+    walk(table)
+    return out
+
+
+def check_call_arity(tree: ast.Module, fork: str) -> list[str]:
+    sigs: dict[str, ast.arguments] = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            sigs[node.name] = node.args  # overlay order: newest wins
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            continue
+        args = sigs.get(node.func.id)
+        if args is None or args.vararg or args.kwarg:
+            continue
+        pos_names = [a.arg for a in args.posonlyargs + args.args]
+        n_required = len(pos_names) - len(args.defaults)
+        n_pos = len(node.args)
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue
+        kw_names = {k.arg for k in node.keywords if k.arg is not None}
+        if None in {k.arg for k in node.keywords}:
+            continue  # **kwargs splat: not statically checkable
+        covered = n_pos + len(kw_names)
+        allowed_kw = set(pos_names) | {a.arg for a in args.kwonlyargs}
+        bad_kw = kw_names - allowed_kw
+        if bad_kw:
+            out.append(f"{fork}: T002 line {node.lineno}: call "
+                       f"{node.func.id}(...) has unknown keyword(s) {sorted(bad_kw)}")
+        elif n_pos > len(pos_names):
+            out.append(f"{fork}: T002 line {node.lineno}: call "
+                       f"{node.func.id}(...) passes {n_pos} positional args, "
+                       f"max {len(pos_names)}")
+        elif covered < n_required - len(
+                {a.arg for a in args.kwonlyargs if a.arg in kw_names}):
+            out.append(f"{fork}: T002 line {node.lineno}: call "
+                       f"{node.func.id}(...) covers {covered} args, "
+                       f"needs {n_required}")
+    return out
+
+
+def check_annotations(tree: ast.Module, fork: str) -> list[str]:
+    out = []
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        missing = [a.arg for a in node.args.posonlyargs + node.args.args
+                   + node.args.kwonlyargs
+                   if a.annotation is None and a.arg not in ("self", "cls")]
+        if missing:
+            out.append(f"{fork}: T003 line {node.lineno}: def {node.name} has "
+                       f"unannotated parameter(s) {missing}")
+        if node.returns is None:
+            out.append(f"{fork}: T003 line {node.lineno}: def {node.name} has "
+                       f"no return annotation")
+    return out
+
+
+def run_gate(fork: str) -> list[str]:
+    src, constants = combined_source(fork)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # the compiler would fail the same way
+        return [f"{fork}: E999 spec source syntax error line {e.lineno}: {e.msg}"]
+    known = known_global_names(fork, constants, tree)
+    findings = check_undefined_names(src, known, fork)
+    findings += check_call_arity(tree, fork)
+    findings += check_annotations(tree, fork)
+    return findings
+
+
+def main(argv) -> int:
+    forks = argv[1:] or FORK_ORDER
+    findings = []
+    for fork in forks:
+        findings.extend(run_gate(fork))
+    for f in findings:
+        print(f)
+    print(f"typegate: {len(forks)} forks, {len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
