@@ -1,0 +1,81 @@
+"""Mesh collectives for curve-group values (SURVEY §2.3 "G1/G2 reduction
+collectives" row).
+
+G1 point addition is a group law, not a ring sum, so GSPMD's automatic
+`psum` insertion cannot reduce it; the collective is spelled out with
+shard_map: each device tree-reduces its local shard of points (all VPU
+work, no communication), ONE `all_gather` moves the n_devices partial sums
+over ICI (~100 bytes/device — the only wire traffic regardless of input
+size), and every device finishes the log2(n_devices) tail reduce
+replicated. This is the scale-out path for registry-wide pubkey
+aggregation (sync-committee aggregate keys, deposit-sweep key checks):
+single-chip `ops/bls12_jax.g1_sum_reduce` handles one device's worth, this
+composes it across the mesh.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops import bls12_jax as K
+from .mesh import DATA_AXIS
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=8)
+def _mesh_reduce_fn(mesh):
+    """One compiled reducer per mesh (jit then caches per input shape);
+    rebuilding the shard_map closure per call would recompile every time."""
+    from jax import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        # every device computes the identical tail reduce from the gathered
+        # partials; the varying-manual-axes checker can't prove that
+        check_vma=False,
+    )
+    def reduce_shards(X, Y, Z):
+        px, py, pz = K.g1_sum_reduce((X, Y, Z))
+        gx = jax.lax.all_gather(px[None], DATA_AXIS, axis=0, tiled=True)
+        gy = jax.lax.all_gather(py[None], DATA_AXIS, axis=0, tiled=True)
+        gz = jax.lax.all_gather(pz[None], DATA_AXIS, axis=0, tiled=True)
+        return K.g1_sum_reduce((gx, gy, gz))
+
+    return jax.jit(reduce_shards)
+
+
+def g1_mesh_sum(pts, mesh):
+    """Sum a mesh-sharded batch of Jacobian G1 points.
+
+    `pts`: (X, Y, Z) arrays of shape (N, limbs), N divisible by the mesh
+    size; sharded (or shardable) on the leading axis. Returns the single
+    Jacobian sum, replicated on every device."""
+    split = NamedSharding(mesh, P(DATA_AXIS))
+    pts = tuple(jax.device_put(a, split) for a in pts)
+    return _mesh_reduce_fn(mesh)(*pts)
+
+
+def g1_small_multiples(n: int):
+    """(X, Y, Z) Jacobian Montgomery arrays of [1]G .. [n]G plus their
+    affine int pairs — the shared fixture for collective checks (the
+    dryrun and tests/test_mesh_collectives.py must agree on encoding)."""
+    import jax.numpy as jnp
+
+    from ..crypto import bls12_381 as oracle
+
+    enc = K.F.ints_to_mont_batch
+    affs, acc = [], oracle.G1_GEN
+    for _ in range(n):
+        affs.append(oracle.pt_to_affine(oracle.FP_FIELD, acc))
+        acc = oracle.pt_add(oracle.FP_FIELD, acc, oracle.G1_GEN)
+    X = jnp.asarray(enc([a[0] for a in affs]))
+    Y = jnp.asarray(enc([a[1] for a in affs]))
+    Z = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), X.shape)
+    return (X, Y, Z), affs

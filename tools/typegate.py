@@ -131,21 +131,26 @@ def check_call_arity(tree: ast.Module, fork: str) -> list[str]:
         kw_names = {k.arg for k in node.keywords if k.arg is not None}
         if None in {k.arg for k in node.keywords}:
             continue  # **kwargs splat: not statically checkable
-        covered = n_pos + len(kw_names)
         allowed_kw = set(pos_names) | {a.arg for a in args.kwonlyargs}
         bad_kw = kw_names - allowed_kw
+        # positional params satisfied: by position, or by keyword naming one
+        pos_covered = n_pos + len(kw_names & set(pos_names))
+        double_bound = kw_names & set(pos_names[:n_pos])
         if bad_kw:
             out.append(f"{fork}: T002 line {node.lineno}: call "
                        f"{node.func.id}(...) has unknown keyword(s) {sorted(bad_kw)}")
+        elif double_bound:
+            out.append(f"{fork}: T002 line {node.lineno}: call "
+                       f"{node.func.id}(...) binds {sorted(double_bound)} both "
+                       f"positionally and by keyword")
         elif n_pos > len(pos_names):
             out.append(f"{fork}: T002 line {node.lineno}: call "
                        f"{node.func.id}(...) passes {n_pos} positional args, "
                        f"max {len(pos_names)}")
-        elif covered < n_required - len(
-                {a.arg for a in args.kwonlyargs if a.arg in kw_names}):
+        elif pos_covered < n_required:
             out.append(f"{fork}: T002 line {node.lineno}: call "
-                       f"{node.func.id}(...) covers {covered} args, "
-                       f"needs {n_required}")
+                       f"{node.func.id}(...) covers {pos_covered} of "
+                       f"{n_required} required positional args")
     return out
 
 
