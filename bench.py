@@ -247,7 +247,7 @@ def main() -> None:
         force_cpu()
         N_VALIDATORS = min(N_VALIDATORS, CPU_DEBUG_VALIDATORS)
         N_BLS = min(N_BLS, CPU_DEBUG_BLS)
-        os.environ.setdefault("BENCH_ATT_VALIDATORS", "8192")
+        os.environ.setdefault("BENCH_ATT_VALIDATORS", "4096")
     try:
         record = run_benches()
         if cpu_debug:
