@@ -335,3 +335,23 @@ def test_aggregate_and_proof_envelope_verifies(real_bls, spec, state):
     domain = spec.get_domain(
         st, spec.DOMAIN_AGGREGATE_AND_PROOF, spec.compute_epoch_at_slot(att.data.slot))
     assert bls.Verify(pubkey, spec.compute_signing_root(proof, domain), env_sig)
+
+
+def test_process_sync_committee_contributions(aspec):
+    """Contribution folding: bits land at subcommittee-offset positions and
+    the empty case produces the canonical infinity-signature aggregate."""
+    block = aspec.BeaconBlock()
+    size = int(aspec.SYNC_COMMITTEE_SIZE) // int(aspec.SYNC_COMMITTEE_SUBNET_COUNT)
+    c0 = aspec.SyncCommitteeContribution(slot=0, subcommittee_index=0)
+    c0.aggregation_bits[0] = True
+    c1 = aspec.SyncCommitteeContribution(slot=0, subcommittee_index=2)
+    c1.aggregation_bits[size - 1] = True
+    aspec.process_sync_committee_contributions(block, [c0, c1])
+    bits = block.body.sync_aggregate.sync_committee_bits
+    assert bits[0] and bits[2 * size + size - 1]
+    assert sum(1 for b in bits if b) == 2
+
+    empty = aspec.BeaconBlock()
+    aspec.process_sync_committee_contributions(empty, [])
+    assert (bytes(empty.body.sync_aggregate.sync_committee_signature)
+            == bytes(aspec.G2_POINT_AT_INFINITY))
