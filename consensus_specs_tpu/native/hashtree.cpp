@@ -19,6 +19,11 @@
 #include <cstring>
 #include <cstddef>
 
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+#define HASHTREE_X86 1
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr uint32_t K[64] = {
@@ -69,16 +74,138 @@ void compress(uint32_t state[8], const uint8_t block[64]) {
   state[4] += e; state[5] += f; state[6] += g; state[7] += h;
 }
 
+#ifdef HASHTREE_X86
+// SHA-NI single-block compression (the Intel SHA extensions flow; same
+// instruction sequence every hardware sha256 implementation uses). The
+// 64-byte Merkle-pair digest is two of these: data block + fixed padding.
+__attribute__((target("sha,sse4.1,ssse3")))
+void compress_shani(uint32_t state[8], const uint8_t block[64]) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i STATE0, STATE1, MSG, TMP, MSG0, MSG1, MSG2, MSG3;
+
+  TMP = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&state[0]));
+  STATE1 = _mm_loadu_si128(reinterpret_cast<const __m128i*>(&state[4]));
+  TMP = _mm_shuffle_epi32(TMP, 0xB1);        // CDAB
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);  // EFGH
+  STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);  // ABEF
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);  // CDGH
+
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+
+#define QROUND(Ka, Kb, MA)                                   \
+  MSG = _mm_add_epi32(MA, _mm_set_epi64x(Kb, Ka));           \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);       \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                        \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG)
+
+  // rounds 0-15: raw message words
+  MSG0 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 0)), MASK);
+  QROUND(0x71374491428A2F98LL, 0xE9B5DBA5B5C0FBCFLL, MSG0);
+  MSG1 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 16)), MASK);
+  QROUND(0x59F111F13956C25BLL, 0xAB1C5ED5923F82A4LL, MSG1);
+  MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+  MSG2 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 32)), MASK);
+  QROUND(0x12835B01D807AA98LL, 0x550C7DC3243185BELL, MSG2);
+  MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+  MSG3 = _mm_shuffle_epi8(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(block + 48)), MASK);
+
+  // rounds 12-51: full schedule pipeline, registers rotating
+#define SCHED_QROUND(Ka, Kb, MA, MB, MC, MD)                 \
+  MSG = _mm_add_epi32(MA, _mm_set_epi64x(Kb, Ka));           \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);       \
+  TMP = _mm_alignr_epi8(MA, MD, 4);                          \
+  MB = _mm_add_epi32(MB, TMP);                               \
+  MB = _mm_sha256msg2_epu32(MB, MA);                         \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                        \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);       \
+  MD = _mm_sha256msg1_epu32(MD, MA)
+
+  SCHED_QROUND(0x80DEB1FE72BE5D74LL, 0xC19BF1749BDC06A7LL, MSG3, MSG0, MSG1, MSG2);
+  SCHED_QROUND(0xEFBE4786E49B69C1LL, 0x240CA1CC0FC19DC6LL, MSG0, MSG1, MSG2, MSG3);
+  SCHED_QROUND(0x4A7484AA2DE92C6FLL, 0x76F988DA5CB0A9DCLL, MSG1, MSG2, MSG3, MSG0);
+  SCHED_QROUND(0xA831C66D983E5152LL, 0xBF597FC7B00327C8LL, MSG2, MSG3, MSG0, MSG1);
+  SCHED_QROUND(0xD5A79147C6E00BF3LL, 0x1429296706CA6351LL, MSG3, MSG0, MSG1, MSG2);
+  SCHED_QROUND(0x2E1B213827B70A85LL, 0x53380D134D2C6DFCLL, MSG0, MSG1, MSG2, MSG3);
+  SCHED_QROUND(0x766A0ABB650A7354LL, 0x92722C8581C2C92ELL, MSG1, MSG2, MSG3, MSG0);
+  SCHED_QROUND(0xA81A664BA2BFE8A1LL, 0xC76C51A3C24B8B70LL, MSG2, MSG3, MSG0, MSG1);
+  SCHED_QROUND(0xD6990624D192E819LL, 0x106AA070F40E3585LL, MSG3, MSG0, MSG1, MSG2);
+
+  // rounds 48-51: last group that still primes a register (MSG3 feeds the
+  // 60-63 words); afterwards only msg2 chains remain
+  MSG = _mm_add_epi32(MSG0, _mm_set_epi64x(0x34B0BCB52748774CLL, 0x1E376C0819A4C116LL));
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+  TMP = _mm_alignr_epi8(MSG0, MSG3, 4);
+  MSG1 = _mm_add_epi32(MSG1, TMP);
+  MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+  MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+
+  MSG = _mm_add_epi32(MSG1, _mm_set_epi64x(0x682E6FF35B9CCA4FLL, 0x4ED8AA4A391C0CB3LL));
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+  TMP = _mm_alignr_epi8(MSG1, MSG0, 4);
+  MSG2 = _mm_add_epi32(MSG2, TMP);
+  MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+  // rounds 60-63
+  MSG = _mm_add_epi32(MSG2, _mm_set_epi64x(0x8CC7020884C87814LL, 0x78A5636F748F82EELL));
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+  TMP = _mm_alignr_epi8(MSG2, MSG1, 4);
+  MSG3 = _mm_add_epi32(MSG3, TMP);
+  MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+  MSG = _mm_add_epi32(MSG3, _mm_set_epi64x(0xC67178F2BEF9A3F7LL, 0xA4506CEB90BEFFFALL));
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+#undef QROUND
+#undef SCHED_QROUND
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);        // FEBA
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);     // DCHG
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);  // DCBA
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);     // HGFE
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[0]), STATE0);
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(&state[4]), STATE1);
+}
+
+bool have_shani() {
+  static const bool v =
+      __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+  return v;
+}
+#endif  // HASHTREE_X86
+
+inline void compress_dispatch(uint32_t state[8], const uint8_t block[64]) {
+#ifdef HASHTREE_X86
+  if (have_shani()) { compress_shani(state, block); return; }
+#endif
+  compress(state, block);
+}
+
 // Digest of exactly one 64-byte input (the Merkle pair case): one data
 // block plus one constant padding block (0x80, zeros, bit-length 512).
 void sha256_64(const uint8_t in[64], uint8_t out[32]) {
   uint32_t st[8];
   std::memcpy(st, H0, sizeof st);
-  compress(st, in);
+  compress_dispatch(st, in);
   uint8_t pad[64] = {0};
   pad[0] = 0x80;
   pad[62] = 0x02;  // 512 bits, big-endian in the last 8 bytes
-  compress(st, pad);
+  compress_dispatch(st, pad);
   for (int i = 0; i < 8; i++) store_be(out + 4 * i, st[i]);
 }
 
@@ -86,7 +213,7 @@ void sha256_any(const uint8_t* in, size_t len, uint8_t* out) {
   uint32_t st[8];
   std::memcpy(st, H0, sizeof st);
   size_t off = 0;
-  for (; off + 64 <= len; off += 64) compress(st, in + off);
+  for (; off + 64 <= len; off += 64) compress_dispatch(st, in + off);
   uint8_t tail[128] = {0};
   size_t rem = len - off;
   std::memcpy(tail, in + off, rem);
@@ -95,8 +222,8 @@ void sha256_any(const uint8_t* in, size_t len, uint8_t* out) {
   uint64_t bits = uint64_t(len) * 8;
   uint8_t* lenp = tail + tail_blocks * 64 - 8;
   for (int i = 0; i < 8; i++) lenp[i] = uint8_t(bits >> (56 - 8 * i));
-  compress(st, tail);
-  if (tail_blocks == 2) compress(st, tail + 64);
+  compress_dispatch(st, tail);
+  if (tail_blocks == 2) compress_dispatch(st, tail + 64);
   for (int i = 0; i < 8; i++) store_be(out + 4 * i, st[i]);
 }
 

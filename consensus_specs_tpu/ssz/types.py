@@ -83,6 +83,86 @@ def _mark_dirty(obj) -> None:
             stack.append(p)
 
 
+def _pack_le_blob(arr, size: int) -> bytes:
+    """Little-endian byte blob of a numpy column, zero-padded to a chunk
+    multiple — the single packing rule shared by from_numpy's tree seeding
+    and the cold-build fast path."""
+    import numpy as np
+
+    if arr.dtype == np.bool_:
+        arr = arr.astype(np.uint8)
+    blob = np.ascontiguousarray(arr).astype(f"<u{size}", copy=False).tobytes()
+    if len(blob) % BYTES_PER_CHUNK:
+        blob += b"\x00" * (BYTES_PER_CHUNK - len(blob) % BYTES_PER_CHUNK)
+    return blob
+
+
+def _batch_container_roots(elems, typ) -> list | None:
+    """Vectorized hash_tree_root for a homogeneous batch of FIXED-SIZE
+    containers whose fields are uints/booleans/ByteVectors (<= 2 chunks
+    per field) — the Validator shape. Field columns pack via numpy/C-level
+    joins and every tree level hashes in ONE `hash_pairs_blob` call across
+    the whole batch, replacing len(elems) Python merkleizations (the cold
+    1M-validator registry build's dominant cost). Returns None when the
+    shape doesn't qualify (caller falls back to per-element roots).
+
+    Also CACHES each element's root: the incremental-merkleization
+    invariant requires every element under a built tree to carry a valid
+    root cache."""
+    import numpy as np
+
+    from .merkle import hash_pairs_blob
+
+    n = len(elems)
+    if n < 256 or not (isinstance(typ, type) and issubclass(typ, Container)):
+        return None
+    if not typ.is_fixed_size():
+        return None
+    fields = typ.fields()
+    if len(fields) > 32:
+        return None
+    _np_dtypes = {1: "<u1", 2: "<u2", 4: "<u4", 8: "<u8"}
+    cols = []
+    for name, ft in fields.items():
+        col = np.zeros((n, BYTES_PER_CHUNK), dtype=np.uint8)
+        if issubclass(ft, (uint, boolean)):
+            size = ft.type_byte_length()
+            if size not in _np_dtypes:
+                return None  # uint128/uint256: no numpy dtype
+            arr = np.fromiter(
+                (getattr(e, name) for e in elems), dtype=_np_dtypes[size], count=n)
+            col[:, :size] = arr.view(np.uint8).reshape(n, size)
+        elif issubclass(ft, ByteVector):
+            length = ft.LENGTH
+            blob = b"".join(getattr(e, name) for e in elems)
+            raw = np.frombuffer(blob, dtype=np.uint8).reshape(n, length)
+            if length <= BYTES_PER_CHUNK:
+                col[:, :length] = raw
+            elif length <= 2 * BYTES_PER_CHUNK:
+                wide = np.zeros((n, 2 * BYTES_PER_CHUNK), dtype=np.uint8)
+                wide[:, :length] = raw
+                col = np.frombuffer(
+                    hash_pairs_blob(wide.tobytes()), dtype=np.uint8).reshape(n, 32)
+            else:
+                return None
+        else:
+            return None
+        cols.append(col)
+    # (n, F, 32) with F padded to the next power of two by zero chunks
+    F = 1 if len(cols) <= 1 else 1 << (len(cols) - 1).bit_length()
+    mat = np.zeros((n, F, BYTES_PER_CHUNK), dtype=np.uint8)
+    for k, col in enumerate(cols):
+        mat[:, k, :] = col
+    while F > 1:
+        out = hash_pairs_blob(mat.tobytes())
+        F //= 2
+        mat = np.frombuffer(out, dtype=np.uint8).reshape(n, F, BYTES_PER_CHUNK)
+    roots = [mat[i, 0].tobytes() for i in range(n)]
+    for e, r in zip(elems, roots):
+        object.__setattr__(e, "_root_cache", r)
+    return roots
+
+
 def _copy_merkle_state(src, dst) -> None:
     """Carry cached merkle state from `src` to its fresh copy `dst`: same
     content means same root, and the IncrementalTree clones (it is mutated
@@ -717,6 +797,18 @@ class _Sequence(SSZType):
             return None
         return self._elems[ci].hash_tree_root()
 
+    def _pack_blob_fast(self):
+        """Chunk blob for big basic-element sequences via one numpy pass
+        (1M Python encode_bytes calls otherwise dominate cold builds);
+        None when the element dtype has no numpy representation."""
+        et = self.ELEM_TYPE
+        if len(self._elems) < 1024 or not _is_basic(et):
+            return None  # _is_basic first: variable-size types have no length
+        size = et.type_byte_length()
+        if size not in (1, 2, 4, 8):
+            return None
+        return _pack_le_blob(self.to_numpy(), size)
+
     def _merkle_root(self, limit_chunks: int | None) -> bytes:
         """Chunk-tree root (before any length mix-in), maintained
         incrementally: dirty chunks rehash O(dirty · log n) through the
@@ -733,17 +825,25 @@ class _Sequence(SSZType):
                 tree.update(updates)
                 dirty.clear()
             return tree.root()
-        chunks = self._chunks()
+        blob = self._pack_blob_fast()
+        if blob is None:
+            chunks = self._chunks()
+            blob = b"".join(chunks)
+            n_chunks = len(chunks)
+        else:
+            chunks = None
+            n_chunks = len(blob) // BYTES_PER_CHUNK
         dirty = self.__dict__.get("_dirty")
         if dirty:
             dirty.clear()
         object.__setattr__(self, "_structural", False)
-        if len(chunks) >= _TREE_MIN_CHUNKS:
+        if n_chunks >= _TREE_MIN_CHUNKS:
             tree = IncrementalTree(
-                b"".join(chunks),
-                len(chunks) if limit_chunks is None else limit_chunks)
+                blob, n_chunks if limit_chunks is None else limit_chunks)
             object.__setattr__(self, "_tree", tree)
             return tree.root()
+        # small sequence: chunks is always populated here (the fast-blob
+        # path implies >= 1024 elements and therefore >= _TREE_MIN_CHUNKS)
         object.__setattr__(self, "_tree", None)
         return merkleize_chunks(chunks, limit=limit_chunks)
 
@@ -855,9 +955,7 @@ class _Sequence(SSZType):
         size = et.type_byte_length()
         arr = np.ascontiguousarray(arr)
         out = cls.from_values(arr.tolist())
-        blob = arr.astype(f"<u{size}", copy=False).tobytes()
-        if len(blob) % BYTES_PER_CHUNK:
-            blob += b"\x00" * (BYTES_PER_CHUNK - len(blob) % BYTES_PER_CHUNK)
+        blob = _pack_le_blob(arr, size)
         if len(blob) // BYTES_PER_CHUNK >= _TREE_MIN_CHUNKS:
             limit = out.chunk_limit() if hasattr(out, "chunk_limit") else out.chunk_count()
             object.__setattr__(out, "_tree", IncrementalTree(blob, limit))
@@ -907,6 +1005,9 @@ class _Sequence(SSZType):
         et = self.ELEM_TYPE
         if _is_basic(et):
             return _pack_bytes_to_chunks(b"".join(e.encode_bytes() for e in self._elems))
+        batched = _batch_container_roots(self._elems, et)
+        if batched is not None:
+            return batched
         return [e.hash_tree_root() for e in self._elems]
 
 

@@ -340,3 +340,17 @@ def test_per_slot_cost_drops():
     o.hash_tree_root()
     warm = time.perf_counter() - t0
     assert warm < cold / 5, (cold, warm)
+
+
+def test_large_variable_size_container_list():
+    """The fast blob/batch paths must fall back cleanly for variable-size
+    element types (review regression: type_byte_length() raised before the
+    basic-type guard)."""
+    class VarC(Container):
+        a: uint64
+        bits: Bitlist[64]
+
+    lst = List[VarC, 4096]([VarC(a=i, bits=[True] * (i % 8)) for i in range(1100)])
+    assert lst.hash_tree_root() == fresh_root(lst)
+    lst[3].a = 999
+    assert lst.hash_tree_root() == fresh_root(lst)
