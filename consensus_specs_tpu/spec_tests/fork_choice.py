@@ -269,3 +269,120 @@ def test_on_attestation_unknown_block_rejected(spec, state):
     sign_attestation(spec, state, attestation)
     add_attestation_step(spec, store, parts, steps, attestation, valid=False)
     yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_latest_message_supersedes_earlier_vote(spec, state):
+    """LMD: a validator's newer attestation replaces its older one — the
+    head follows the LATEST message, not the accumulated history."""
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 2)
+    base = state.copy()
+    # two competing branches at slot 1 and 2
+    state_a, state_b = base.copy(), base.copy()
+    block_a = build_empty_block(spec, state_a, spec.Slot(1))
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block(spec, state_b, spec.Slot(2))
+    block_b.body.graffiti = spec.Bytes32(b"\x42" * 32)
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    add_block_step(spec, store, parts, steps, signed_a)
+    add_block_step(spec, store, parts, steps, signed_b)
+
+    # same committee member first votes A...
+    att_a = get_valid_attestation(spec, state_a, slot=spec.Slot(1), signed=False,
+                                  filter_participant_set=lambda c: {sorted(c)[0]})
+    sign_attestation(spec, state_a, att_a)
+    tick_to_slot_step(spec, store, steps, 3)
+    add_attestation_step(spec, store, parts, steps, att_a)
+    head_1 = add_checks_step(spec, store, steps)
+    assert head_1 == spec.hash_tree_root(block_a)
+
+    # ...then votes B one epoch later: only the new message counts
+    next_slots(spec, state_b, int(spec.SLOTS_PER_EPOCH))
+    att_b = get_valid_attestation(
+        spec, state_b, slot=spec.Slot(int(spec.SLOTS_PER_EPOCH) + 1), signed=False,
+        filter_participant_set=lambda c: set(c))
+    sign_attestation(spec, state_b, att_b)
+    tick_to_slot_step(spec, store, steps, int(spec.SLOTS_PER_EPOCH) + 2)
+    add_attestation_step(spec, store, parts, steps, att_b)
+    head_2 = add_checks_step(spec, store, steps)
+    assert head_2 == spec.hash_tree_root(block_b)
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_zero_weight_tiebreak_is_deterministic(spec, state):
+    """Competing weightless branches: get_head's tie-break (max by root)
+    must be stable — replaying the same store yields the same head."""
+    store, parts, steps = initialize_steps(spec, state)
+    # deliver the competing blocks AFTER their slot: none may carry the
+    # proposer boost, or the tie is not a tie
+    tick_to_slot_step(spec, store, steps, 2)
+    base = state.copy()
+    signed = []
+    for tag in (b"\x01", b"\x02", b"\x03"):
+        st = base.copy()
+        block = build_empty_block(spec, st, spec.Slot(1))
+        block.body.graffiti = spec.Bytes32(tag * 32)
+        signed.append(state_transition_and_sign_block(spec, st, block))
+    for s in signed:
+        add_block_step(spec, store, parts, steps, s)
+    head = add_checks_step(spec, store, steps)
+    expected = max(spec.hash_tree_root(s.message) for s in signed)
+    assert head == expected, "tie-break must pick the lexicographically largest root"
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_block_attestations_bypass_gossip_timeliness(spec, state):
+    """An attestation for slot s is NOT usable from gossip until s+1, but
+    the same attestation arriving INSIDE a block is (is_from_block=True) —
+    the block's own timeliness already gates it."""
+    store, parts, steps = initialize_steps(spec, state)
+    tick_to_slot_step(spec, store, steps, 1)
+    st = state.copy()
+    block1 = build_empty_block(spec, st, spec.Slot(1))
+    signed1 = state_transition_and_sign_block(spec, st, block1)
+    add_block_step(spec, store, parts, steps, signed1)
+
+    att = get_valid_attestation(spec, st, slot=spec.Slot(1), signed=False,
+                                filter_participant_set=lambda c: set(c))
+    sign_attestation(spec, st, att)
+    # gossip delivery at the attestation's own slot: rejected
+    add_attestation_step(spec, store, parts, steps, att, valid=False)
+
+    # inclusion in a block at slot 2: accepted (add_block_step feeds block
+    # attestations through on_attestation with is_from_block=True)
+    block2 = build_empty_block(spec, st, spec.Slot(2))
+    block2.body.attestations.append(att)
+    signed2 = state_transition_and_sign_block(spec, st, block2)
+    tick_to_slot_step(spec, store, steps, 2)
+    add_block_step(spec, store, parts, steps, signed2)
+    # the vote is live in the store now
+    assert any(int(i) in store.latest_messages for i in range(len(state.validators)))
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_updates_store_via_on_block(spec, state):
+    """Two fully-attested epochs justify epoch 1; the block carrying the
+    justifying epoch transition updates store.justified_checkpoint."""
+    from ..testlib.attestations import next_epoch_with_attestations
+
+    store, parts, steps = initialize_steps(spec, state)
+    signed_blocks = []
+    st = state.copy()
+    for _ in range(3):
+        _, new_signed, st = next_epoch_with_attestations(spec, st, True, False)
+        signed_blocks.extend(new_signed)
+    tick_to_slot_step(spec, store, steps, int(st.slot))
+    for s in signed_blocks:
+        add_block_step(spec, store, parts, steps, s)
+    add_checks_step(spec, store, steps)
+    assert int(store.justified_checkpoint.epoch) >= 1, (
+        "three attested epochs must justify at least epoch 1")
+    yield from finalize_steps(parts, steps)
