@@ -76,7 +76,23 @@ class EpochConfig:
 
     @classmethod
     def from_spec(cls, spec) -> "EpochConfig":
-        """Build from a compiled spec module (altair or later)."""
+        """Build from a compiled spec module (altair or later).
+
+        Two epoch constants are fork-dependent: bellatrix finalizes the
+        punitive parameters (PROPORTIONAL_SLASHING_MULTIPLIER 2 -> 3,
+        INACTIVITY_PENALTY_QUOTIENT 3*2^24 -> 2^24); later R&D overlays
+        inherit bellatrix's values. The engine program is otherwise
+        identical across the altair family — the config carries the
+        difference, so one compiled kernel serves every fork."""
+        from ..forks import is_post
+
+        bellatrix_plus = is_post(spec.fork, "bellatrix")
+        slash_mult = int(
+            spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX if bellatrix_plus
+            else spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR)
+        inactivity_q = int(
+            spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX if bellatrix_plus
+            else spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
         return cls(
             slots_per_epoch=int(spec.SLOTS_PER_EPOCH),
             epochs_per_slashings_vector=int(spec.EPOCHS_PER_SLASHINGS_VECTOR),
@@ -89,8 +105,8 @@ class EpochConfig:
             hysteresis_downward_multiplier=int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER),
             hysteresis_upward_multiplier=int(spec.HYSTERESIS_UPWARD_MULTIPLIER),
             min_epochs_to_inactivity_penalty=int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY),
-            proportional_slashing_multiplier=int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR),
-            inactivity_penalty_quotient=int(spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR),
+            proportional_slashing_multiplier=slash_mult,
+            inactivity_penalty_quotient=inactivity_q,
             max_seed_lookahead=int(spec.MAX_SEED_LOOKAHEAD),
             min_seed_lookahead=int(spec.MIN_SEED_LOOKAHEAD),
             epochs_per_sync_committee_period=int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD),

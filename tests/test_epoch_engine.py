@@ -164,3 +164,53 @@ def test_sync_committee_sampler_matches_spec(spec):
         shuffle_round_count=int(spec.SHUFFLE_ROUND_COUNT),
     )
     assert [int(x) for x in got] == want
+
+
+# --- bellatrix: the engine must track the fork's punitive parameters ---------
+
+
+@pytest.fixture(scope="module")
+def bspec():
+    return get_spec("bellatrix", "minimal")
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_epoch_engine_bellatrix_differential(bspec, seed):
+    """Engine vs bellatrix spec with slashed validators and inactivity
+    scores in play — exercising both fork-changed constants
+    (PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+    INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)."""
+    from consensus_specs_tpu.ssz import hash_tree_root
+
+    rng = random.Random(seed)
+    state = create_valid_beacon_state(bspec, 64)
+    next_epoch(bspec, state)
+    next_epoch(bspec, state)
+    randomize_state(bspec, state, rng, leak=bool(seed % 2))
+    # force slashings into the withdrawable window so process_slashings bites
+    current = bspec.get_current_epoch(state)
+    half_vector = int(bspec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    for i in range(0, len(state.validators), 3):
+        v = state.validators[i]
+        v.slashed = True
+        v.withdrawable_epoch = bspec.Epoch(int(current) + half_vector)
+        state.slashings[int(current) % int(bspec.EPOCHS_PER_SLASHINGS_VECTOR)] += (
+            v.effective_balance)
+    slot = int(state.slot)
+    per_epoch = int(bspec.SLOTS_PER_EPOCH)
+    transition_to(bspec, state, slot + (per_epoch - 1 - slot % per_epoch))
+
+    via_spec = state.copy()
+    bspec.process_epoch(via_spec)
+    via_engine = state.copy()
+    apply_epoch_via_engine(bspec, via_engine)
+    assert hash_tree_root(via_spec) == hash_tree_root(via_engine)
+
+
+def test_bellatrix_config_constants(bspec, spec):
+    from consensus_specs_tpu.engine.state import EpochConfig
+
+    alt, bel = EpochConfig.from_spec(spec), EpochConfig.from_spec(bspec)
+    assert bel.proportional_slashing_multiplier == 3
+    assert alt.proportional_slashing_multiplier == 2
+    assert bel.inactivity_penalty_quotient < alt.inactivity_penalty_quotient
