@@ -239,6 +239,9 @@ def persist_local(record: dict) -> None:
 def main() -> None:
     global N_VALIDATORS, N_BLS
     record: dict
+    from consensus_specs_tpu.utils.backend import enable_compile_cache
+
+    enable_compile_cache()
     platform = probe_accelerator()
     cpu_debug = platform is None or platform == "cpu"
     if cpu_debug:

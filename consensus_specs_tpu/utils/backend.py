@@ -15,6 +15,28 @@ from __future__ import annotations
 ACCELERATOR_PLUGINS = ("axon", "tpu", "cuda", "rocm")
 
 
+def enable_compile_cache(path: str | None = None):
+    """Point JAX at a persistent on-disk compilation cache.
+
+    The pairing/epoch kernels compile for minutes; caching the serialized
+    XLA executables means only the first run on a given machine+code state
+    pays. Works for both the CPU mesh and the TPU backend (entries are
+    keyed by platform + HLO hash, so they never collide). Safe to delete
+    the directory at any time. Returns the jax module."""
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return jax
+
+
 def force_cpu(n_devices: int | None = None):
     """Pin this process to the CPU backend; with `n_devices`, provision a
     virtual multi-device CPU mesh (tearing down any already-initialized
