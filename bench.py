@@ -171,6 +171,10 @@ def run_benches() -> dict:
             import benches.epoch_e2e_bench as e2e_bench
 
             e2e = e2e_bench.run(int(os.environ.get("BENCH_E2E_VALIDATORS", N_VALIDATORS)))
+        with timed("bench_kzg"):
+            import benches.kzg_bench as kzg_bench
+
+            kzg_r = kzg_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -194,6 +198,10 @@ def run_benches() -> dict:
             "epoch_e2e_s": e2e["e2e_epoch_s"],
             "epoch_e2e_stages_s": e2e["stages_s"],
             "epoch_e2e_validators": e2e["validators"],
+            # BASELINE config 5: batched KZG sample verification per block
+            "kzg_blobs_per_s": kzg_r["blobs_per_s"],
+            "kzg_batch_verify_s": kzg_r["batch_verify_s"],
+            "kzg_blobs": kzg_r["blobs"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],
@@ -251,6 +259,7 @@ def main() -> None:
         N_VALIDATORS = min(N_VALIDATORS, CPU_DEBUG_VALIDATORS)
         N_BLS = min(N_BLS, CPU_DEBUG_BLS)
         os.environ.setdefault("BENCH_ATT_VALIDATORS", "4096")
+        os.environ.setdefault("BENCH_KZG_BLOBS", "16")
     try:
         record = run_benches()
         if cpu_debug:

@@ -1,0 +1,88 @@
+"""BASELINE config 5: KZG polynomial-commitment verification for one
+block's worth of data blobs (128 at the sharding mainnet preset).
+
+Measured region: ONE randomized batched check over 128 (commitment,
+sample, multiproof) triples — `crypto/kzg_batch.batch_verify_samples`,
+i.e. two device pairings + two batched G1 ladders, soundness 2^-64 —
+plus its host prep (per-item 8-point interpolation, scalar folds). That
+is the per-node DAS verification load for a full block: one sample per
+blob per sampler draw.
+
+The per-item pairing cost of a sample verify is independent of blob size
+(the proof is one G1 point; the interpolant has POINTS_PER_SAMPLE
+coefficients), so the bench keeps setup tractable with small blobs
+(32 points each) while measuring exactly the verification work 2048-point
+mainnet blobs would cost. Setup (trusted-setup powers, proving) is
+excluded and reported separately.
+
+Usage: python benches/kzg_bench.py [n_blobs] — one JSON line.
+"""
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+N_DATA = 32  # points per blob (verification cost is blob-size independent)
+M = 8  # POINTS_PER_SAMPLE
+
+
+def default_blobs() -> int:
+    return int(os.environ.get("BENCH_KZG_BLOBS", 128))
+
+
+def run(n_blobs: int | None = None):
+    from consensus_specs_tpu.crypto import das, kzg, kzg_batch
+
+    if n_blobs is None:
+        n_blobs = default_blobs()
+    t0 = time.time()
+    setup = kzg.insecure_test_setup(2 * N_DATA)
+    print(f"# kzg setup ({2 * N_DATA} powers): {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    items = []
+    cosets = das.sample_cosets(2 * N_DATA, M)
+    for b in range(n_blobs):
+        data = [pow(7, 31 * b + i + 1, kzg.MODULUS) for i in range(N_DATA)]
+        commitment, samples = das.sample_data(setup, data, M, use_device=False)
+        s = samples[b % len(samples)]  # one sampled coset per blob
+        shift, _ = cosets[s.index]
+        items.append((commitment, shift, list(s.values), s.proof))
+    print(f"# {n_blobs} blobs committed+proved: {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    assert kzg_batch.batch_verify_samples(setup, items)
+    compile_s = time.time() - t0
+    print(f"# kzg batch compile+first: {compile_s:.1f}s", file=sys.stderr)
+
+    times = []
+    for _ in range(3):
+        t0 = time.time()
+        assert kzg_batch.batch_verify_samples(setup, items)
+        times.append(time.time() - t0)
+    batch_s = min(times)
+    return {
+        "blobs": n_blobs,
+        "batch_verify_s": round(batch_s, 4),
+        "blobs_per_s": round(n_blobs / batch_s, 1),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else default_blobs()
+    r = run(n)
+    print(json.dumps({
+        "metric": "kzg_blob_verify_throughput",
+        "value": r["blobs_per_s"],
+        "unit": "blobs/sec/chip",
+        "vs_baseline": None,
+        **r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

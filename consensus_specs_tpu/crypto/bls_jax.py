@@ -16,11 +16,25 @@ exponentiation per item.
 """
 from __future__ import annotations
 
+from functools import lru_cache
+
 import numpy as np
 
 from . import bls12_381 as oracle
 from .hash_to_curve import hash_to_curve_g2
-from .bls12_381 import g1_from_bytes, g2_from_bytes
+from .bls12_381 import g2_from_bytes
+
+
+@lru_cache(maxsize=1 << 20)
+def g1_from_bytes(data: bytes):
+    """Memoized validated G1 decompression. A node sees the same validator
+    pubkeys every epoch, and the r-subgroup check (a 255-bit scalar
+    multiplication) dominates decompression cost — so cache by the 48
+    compressed bytes, exactly as reference clients cache deserialized
+    pubkeys behind milagro. Invalid encodings raise and are NOT cached
+    (lru_cache does not memoize raising calls): they are attacker-supplied
+    and mostly fail cheaply before the subgroup check."""
+    return oracle.g1_from_bytes(data)
 
 # known-valid padding item: e(G1, G2) * e(-G1, G2) == 1
 _G1 = oracle.G1_GEN_AFF

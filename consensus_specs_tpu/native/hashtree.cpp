@@ -18,6 +18,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstddef>
+#include <thread>
+#include <vector>
 
 #if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
 #define HASHTREE_X86 1
@@ -236,8 +238,62 @@ void hashtree_sha256(const uint8_t* in, size_t len, uint8_t* out32) {
 }
 
 // n sibling pairs (n * 64 bytes contiguous) -> n parents (n * 32 bytes).
+// Large batches fan out over hardware threads; the in-place aliased call
+// (merkle_root's in==out reduction) must stay sequential because parent
+// writes at 32*i overlap later pair reads at 64*j across thread boundaries.
 void hashtree_hash_pairs(const uint8_t* in, size_t n, uint8_t* out) {
-  for (size_t i = 0; i < n; i++) sha256_64(in + 64 * i, out + 32 * i);
+  const size_t kParThreshold = 8192;
+  unsigned hw = std::thread::hardware_concurrency();
+  if (n < kParThreshold || hw < 2 || in == out) {
+    for (size_t i = 0; i < n; i++) sha256_64(in + 64 * i, out + 32 * i);
+    return;
+  }
+  unsigned nt = hw > 16 ? 16 : hw;
+  size_t per = (n + nt - 1) / nt;
+  std::vector<std::thread> ts;
+  for (unsigned t = 0; t < nt; t++) {
+    size_t b = t * per, e = b + per < n ? b + per : n;
+    if (b >= e) break;
+    ts.emplace_back([in, out, b, e]() {
+      for (size_t i = b; i < e; i++) sha256_64(in + 64 * i, out + 32 * i);
+    });
+  }
+  for (auto& th : ts) th.join();
+}
+
+// Build every parent level of the chunk tree bottom-up into `out`
+// contiguously: level 1 (ceil(n/2) nodes), level 2, ... single final node.
+// Odd levels pad with the zero-hash of their height — the same virtual
+// padding rule IncrementalTree applies. Returns nodes written (0 if n < 2).
+long hashtree_build_tree(const uint8_t* leaves, size_t n, uint8_t* out) {
+  if (n < 2) return 0;
+  uint8_t zero[64][32];
+  std::memset(zero[0], 0, 32);
+  for (size_t h = 0; h + 1 < 64; h++) {
+    uint8_t pair[64];
+    std::memcpy(pair, zero[h], 32);
+    std::memcpy(pair + 32, zero[h], 32);
+    sha256_64(pair, zero[h + 1]);
+  }
+  const uint8_t* cur = leaves;
+  uint8_t* w = out;
+  size_t count = n, h = 0;
+  while (count > 1) {
+    size_t pairs = count / 2;
+    size_t parents = (count + 1) / 2;
+    hashtree_hash_pairs(cur, pairs, w);
+    if (count & 1) {
+      uint8_t block[64];
+      std::memcpy(block, cur + (count - 1) * 32, 32);
+      std::memcpy(block + 32, zero[h], 32);
+      sha256_64(block, w + pairs * 32);
+    }
+    cur = w;
+    w += parents * 32;
+    count = parents;
+    h++;
+  }
+  return (long)((w - out) / 32);
 }
 
 // Root of the binary tree over `n` 32-byte leaves padded with zero-subtrees

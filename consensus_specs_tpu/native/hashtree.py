@@ -32,7 +32,8 @@ def _load():
         try:
             if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", str(_LIB), str(_SRC)],
+                    ["g++", "-O2", "-pthread", "-shared", "-fPIC",
+                     "-o", str(_LIB), str(_SRC)],
                     check=True,
                     capture_output=True,
                 )
@@ -44,6 +45,10 @@ def _load():
             lib.hashtree_merkle_root.restype = ctypes.c_long
             lib.hashtree_merkle_root.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            lib.hashtree_build_tree.restype = ctypes.c_long
+            lib.hashtree_build_tree.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
             ]
             _lib = lib
         except Exception:
@@ -77,6 +82,38 @@ def hash_pairs(level: bytes) -> bytes:
     out = ctypes.create_string_buffer(32 * n)
     lib.hashtree_hash_pairs(level, n, out)
     return out.raw
+
+
+def build_tree_levels(leaves: bytes) -> list[bytearray] | None:
+    """All parent levels of the chunk tree over `leaves` (n*32 bytes) in ONE
+    native roundtrip — level 1 (ceil(n/2) nodes) through the single top node,
+    odd levels padded with the zero-hash of their height. None when the
+    native library is unavailable (caller falls back to level-by-level
+    hash_pairs). The one-call shape is what makes registry-scale
+    IncrementalTree seeding (ssz/merkle.py) memcpy-bound instead of
+    Python-roundtrip-bound."""
+    assert len(leaves) % 32 == 0
+    n = len(leaves) // 32
+    lib = _load()
+    if lib is None or n < 2:
+        return None
+    sizes = []
+    c = n
+    while c > 1:
+        c = (c + 1) // 2
+        sizes.append(c)
+    total = sum(sizes)
+    out = ctypes.create_string_buffer(32 * total)
+    written = lib.hashtree_build_tree(leaves, n, out)
+    if written != total:
+        return None
+    levels = []
+    view = memoryview(out)[: 32 * total]
+    off = 0
+    for s in sizes:
+        levels.append(bytearray(view[off : off + 32 * s]))
+        off += 32 * s
+    return levels
 
 
 def merkle_root(leaves: bytes, depth: int) -> bytes:

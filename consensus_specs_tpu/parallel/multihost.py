@@ -1,0 +1,110 @@
+"""Multi-host (DCN) bootstrap and hierarchical mesh construction.
+
+The reference's distributed backend is prose: its p2p spec assumes clients
+bring their own process groups, and its test tooling is single-host. The
+torch-world analog of what a TPU pod needs is NCCL/MPI process-group init;
+the JAX-native shape is different and simpler — one `jax.distributed`
+bootstrap per host, after which `jax.devices()` is the GLOBAL device list
+and a single `Mesh` spans the pod. XLA then routes collectives over ICI
+within a slice and DCN across slices *from the mesh axis structure alone*:
+no explicit send/recv code, no rank bookkeeping.
+
+Layout stance (scaling-book recipe): put the host/slice axis OUTERMOST.
+The epoch engine is pure data parallelism over the registry
+(parallel/mesh.py), so the validator axis shards over (dcn × ici) jointly;
+elementwise sweeps stay local, and the only cross-host traffic is the
+final psum tree of balance/participation reductions — bytes per epoch,
+not registry-sized tensors.
+
+Single-process degenerates cleanly: `initialize()` is a no-op,
+`global_epoch_mesh()` is a (1, n_local) mesh, and the hierarchical
+shardings equal the flat ones — so the whole module is testable on the
+8-virtual-device CPU mesh by factoring it as (2 "hosts" × 4 devices),
+which exercises exactly the two-axis GSPMD lowering a real pod uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "data"  # keep parallel/mesh.py's name: intra-slice registry axis
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               local_device_ids=None) -> bool:
+    """Join the multi-host runtime. One call per host process, BEFORE any
+    backend touch. Returns True when a distributed runtime was started,
+    False for the single-host degenerate case (nothing to do)."""
+    if not num_processes or num_processes == 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def global_epoch_mesh(n_hosts: int | None = None, devices=None):
+    """(dcn, data) mesh over the global device list, host axis outermost.
+
+    `n_hosts` overrides the runtime process count — on a single host this
+    factors the local devices into a virtual host grid, which compiles the
+    identical two-axis GSPMD program a real pod runs (the test strategy)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if n_hosts is None:
+        n_hosts = jax.process_count()
+    if len(devs) % n_hosts:
+        raise ValueError(f"{len(devs)} devices do not factor over {n_hosts} hosts")
+    return Mesh(devs.reshape(n_hosts, -1), (DCN_AXIS, ICI_AXIS))
+
+
+def hierarchical_epoch_shardings(mesh):
+    """EpochState shardings for a (dcn, data) mesh: the registry axis shards
+    over BOTH axes jointly (hosts get contiguous registry blocks, each
+    block split over its slice's ICI); small per-epoch vectors replicate."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..engine.state import EpochState
+    from .mesh import epoch_state_shardings
+
+    flat = epoch_state_shardings(mesh) if len(mesh.axis_names) == 1 else None
+    if flat is not None:
+        return flat
+    split = NamedSharding(mesh, P((DCN_AXIS, ICI_AXIS)))
+    repl = NamedSharding(mesh, P())
+    flat_template = epoch_state_shardings(_flat_reference_mesh(mesh))
+    out = {}
+    from dataclasses import fields
+
+    for f in fields(EpochState):
+        ref = getattr(flat_template, f.name)
+        out[f.name] = split if _is_split(ref) else repl
+    return EpochState(**out)
+
+
+def _flat_reference_mesh(mesh):
+    """A 1D shadow of `mesh` used only to read off which fields the flat
+    layout splits (single source of truth stays in parallel/mesh.py)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(mesh.devices).reshape(-1), (ICI_AXIS,))
+
+
+def _is_split(sharding) -> bool:
+    return any(p is not None for p in sharding.spec)
+
+
+def shard_epoch_state_hierarchical(state, mesh):
+    """Place an EpochState onto a (dcn, data) mesh."""
+    import jax
+
+    return jax.device_put(state, hierarchical_epoch_shardings(mesh))

@@ -1,0 +1,461 @@
+"""Block-operation conformance tests: the reference's long-tail scenarios.
+
+Extends spec_tests/operations.py with the edge-case matrix of
+test/phase0/block_processing/ (delay grid, source/target corruption,
+indexed-attestation index games, slashing eligibility windows, deposit
+balance clamping, exit churn) — scenario-for-scenario parity, bodies
+written against this repo's testlib.
+
+Vector format: tests/formats/operations (pre / <operation> / post?).
+"""
+from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from ..testlib.state import next_epoch, next_slots
+from .operations import _run_op
+
+
+# --- attestation inclusion-delay grid (test_process_attestation.py) ---------
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_correct_sqrt_epoch_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(int(spec.SLOTS_PER_EPOCH) ** 0.5))
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_correct_epoch_delay(spec, state):
+    # exactly at the inclusion-window boundary: slot + SLOTS_PER_EPOCH
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.SLOTS_PER_EPOCH))
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_incorrect_head_min_inclusion_delay(spec, state):
+    # wrong beacon_block_root is NOT a rejection: the attestation is stored
+    # pending (phase0) / earns no head flag (altair), but the block is valid
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.beacon_block_root = spec.Root(b"\x42" * 32)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_incorrect_head_sqrt_epoch_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.beacon_block_root = spec.Root(b"\x42" * 32)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(int(spec.SLOTS_PER_EPOCH) ** 0.5))
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_incorrect_head_and_target_epoch_delay(spec, state):
+    # both head and target roots wrong: still structurally valid at phase0;
+    # target ROOT correctness is a fork-choice/reward concern, not a
+    # process_attestation assert
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.beacon_block_root = spec.Root(b"\x42" * 32)
+    attestation.data.target.root = spec.Root(b"\x99" * 32)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.SLOTS_PER_EPOCH))
+    yield from _run_op(spec, state, "attestation", attestation)
+
+
+# --- attestation source/target corruption ------------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_mismatched_target_and_slot(spec, state):
+    # target epoch must equal the epoch of data.slot
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.target.epoch += 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_old_source_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.epoch = spec.Epoch(max(int(attestation.data.source.epoch) - 1, 0))
+    if attestation.data.source.epoch == state.current_justified_checkpoint.epoch:
+        attestation.data.source.epoch += 5  # genesis edge: force a mismatch
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_new_source_epoch(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.epoch += 1
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_bad_source_root(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.root = spec.Root(b"\xde\xad" * 16)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_source_root_is_target_root(spec, state):
+    # overwrite source root with the target root: mismatch vs justified -> reject
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.data.source.root = attestation.data.target.root
+    if attestation.data.source.root == state.current_justified_checkpoint.root:
+        attestation.data.source.root = spec.Root(b"\x77" * 32)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+# --- aggregation-bits shape --------------------------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_too_few_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    bits = list(attestation.aggregation_bits)
+    attestation.aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        bits[:-1])
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_too_many_aggregation_bits(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    bits = list(attestation.aggregation_bits)
+    attestation.aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        bits + [False])
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attestation_empty_participants_zeroes_sig(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    attestation.aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        [False] * len(attestation.aggregation_bits))
+    attestation.signature = spec.BLSSignature(b"\x00" * 96)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from _run_op(spec, state, "attestation", attestation, valid=False)
+
+
+# --- attester slashing: indexed-attestation index games ----------------------
+# (test_process_attester_slashing.py att1_*/att2_* matrix)
+
+
+def _slashing(spec, state):
+    from ..testlib.slashings import build_attester_slashing
+
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return build_attester_slashing(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_att1_empty_indices(spec, state):
+    from ..testlib.attestations import sign_indexed_attestation
+
+    slashing = _slashing(spec, state)
+    slashing.attestation_1.attesting_indices = []
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_all_empty_indices(spec, state):
+    from ..testlib.attestations import sign_indexed_attestation
+
+    slashing = _slashing(spec, state)
+    slashing.attestation_1.attesting_indices = []
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    slashing.attestation_2.attesting_indices = []
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_att1_high_index(spec, state):
+    slashing = _slashing(spec, state)
+    indices = list(slashing.attestation_1.attesting_indices)
+    indices.append(spec.ValidatorIndex(len(state.validators)))
+    slashing.attestation_1.attesting_indices = indices
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_att2_high_index(spec, state):
+    slashing = _slashing(spec, state)
+    indices = list(slashing.attestation_2.attesting_indices)
+    indices.append(spec.ValidatorIndex(len(state.validators)))
+    slashing.attestation_2.attesting_indices = indices
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attester_slashing_att1_bad_extra_index(spec, state):
+    # an extra committee-external index makes the aggregate signature wrong
+    slashing = _slashing(spec, state)
+    indices = list(slashing.attestation_1.attesting_indices)
+    extra = next(
+        i for i in range(len(state.validators)) if spec.ValidatorIndex(i) not in indices)
+    slashing.attestation_1.attesting_indices = sorted(indices + [spec.ValidatorIndex(extra)])
+    # deliberately NOT re-signed: the signature no longer covers the set
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_att1_duplicate_index_normal_signed(spec, state):
+    from ..testlib.attestations import sign_indexed_attestation
+
+    slashing = _slashing(spec, state)
+    indices = list(slashing.attestation_1.attesting_indices)
+    indices.append(indices[0])  # duplicate breaks sorted-and-unique
+    slashing.attestation_1.attesting_indices = sorted(indices)
+    sign_indexed_attestation(spec, state, slashing.attestation_1)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_att2_duplicate_index_normal_signed(spec, state):
+    from ..testlib.attestations import sign_indexed_attestation
+
+    slashing = _slashing(spec, state)
+    indices = list(slashing.attestation_2.attesting_indices)
+    indices.append(indices[-1])
+    slashing.attestation_2.attesting_indices = sorted(indices)
+    sign_indexed_attestation(spec, state, slashing.attestation_2)
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_participants_already_slashed(spec, state):
+    # pre-slash a strict subset: the slashing still lands (slashed_any on
+    # the remainder)
+    slashing = _slashing(spec, state)
+    overlap = sorted(
+        set(slashing.attestation_1.attesting_indices)
+        & set(slashing.attestation_2.attesting_indices))
+    assert len(overlap) >= 2
+    pre = overlap[: len(overlap) // 2]
+    for i in pre:
+        state.validators[int(i)].slashed = True
+    yield from _run_op(spec, state, "attester_slashing", slashing)
+    assert all(state.validators[int(i)].slashed for i in overlap)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_all_participants_already_slashed(spec, state):
+    # nobody NEW gets slashed -> slashed_any is False -> reject
+    slashing = _slashing(spec, state)
+    overlap = set(slashing.attestation_1.attesting_indices) & set(
+        slashing.attestation_2.attesting_indices)
+    for i in overlap:
+        v = state.validators[int(i)]
+        v.slashed = True
+        v.withdrawable_epoch = spec.get_current_epoch(state)  # not slashable again
+    yield from _run_op(spec, state, "attester_slashing", slashing, valid=False)
+
+
+# --- proposer slashing eligibility windows -----------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_epochs_are_different(spec, state):
+    from ..testlib.keys import privkeys
+    from ..testlib.slashings import build_proposer_slashing, sign_block_header
+
+    slashing = build_proposer_slashing(spec, state)
+    h2 = slashing.signed_header_2.message
+    h2.slot += spec.SLOTS_PER_EPOCH  # different epoch -> not a double proposal
+    slashing.signed_header_2 = sign_block_header(
+        spec, state, h2, privkeys[int(h2.proposer_index)])
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_headers_are_same_sigs_are_different(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    slashing.signed_header_2 = slashing.signed_header_1.copy()
+    slashing.signed_header_2.signature = spec.BLSSignature(
+        bytes(slashing.signed_header_1.signature)[:-1] + b"\x01")
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_proposer_is_not_activated(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[index].activation_epoch = spec.get_current_epoch(state) + 2
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_proposer_is_withdrawn(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    next_epoch(spec, state)
+    slashing = build_proposer_slashing(spec, state)
+    index = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state)
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_invalid_proposer_index(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state)
+    for sh in (slashing.signed_header_1, slashing.signed_header_2):
+        sh.message.proposer_index = spec.ValidatorIndex(len(state.validators))
+    yield from _run_op(spec, state, "proposer_slashing", slashing, valid=False)
+
+
+# --- deposit balance clamping (test_process_deposit.py new_deposit_* ) -------
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_new_max(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    new_index = len(state.validators)
+    deposit = build_deposit_for_index(
+        spec, state, new_index, amount=spec.MAX_EFFECTIVE_BALANCE)
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert state.validators[new_index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_new_over_max(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT * 3
+    deposit = build_deposit_for_index(spec, state, new_index, amount=amount)
+    yield from _run_op(spec, state, "deposit", deposit)
+    # balance carries the full amount; effective balance clamps at max
+    assert int(state.balances[new_index]) == int(amount)
+    assert state.validators[new_index].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_new_under_max(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    new_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT - 1
+    deposit = build_deposit_for_index(spec, state, new_index, amount=amount)
+    yield from _run_op(spec, state, "deposit", deposit)
+    # effective balance rounds DOWN to an increment boundary below amount
+    eff = int(state.validators[new_index].effective_balance)
+    assert eff <= int(amount) and eff % int(spec.EFFECTIVE_BALANCE_INCREMENT) == 0
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_deposit_invalid_sig_top_up(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # top-ups skip proof-of-possession: a bad signature still credits
+    deposit = build_deposit_for_index(
+        spec, state, 0, amount=spec.MAX_EFFECTIVE_BALANCE // 4, signed=False)
+    pre_balance = int(state.balances[0])
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert int(state.balances[0]) > pre_balance
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_eth1_withdrawal_credentials(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # phase0 accepts any credential format (0x01-prefixed included)
+    new_index = len(state.validators)
+    deposit = build_deposit_for_index(
+        spec, state, new_index,
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\x42" * 20)
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert bytes(state.validators[new_index].withdrawal_credentials)[:1] == b"\x01"
+
+
+# --- voluntary exit churn ----------------------------------------------------
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_not_active_long_enough(spec, state):
+    from ..testlib.voluntary_exits import build_voluntary_exit
+
+    # one epoch short of SHARD_COMMITTEE_PERIOD
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD) - 1) * int(spec.SLOTS_PER_EPOCH)
+    signed_exit = build_voluntary_exit(spec, state, 0)
+    yield from _run_op(spec, state, "voluntary_exit", signed_exit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_default_exit_epoch_subsequent_exit(spec, state):
+    from ..testlib.voluntary_exits import (
+        age_state_past_shard_committee_period,
+        build_voluntary_exit,
+    )
+
+    age_state_past_shard_committee_period(spec, state)
+    first = build_voluntary_exit(spec, state, 0)
+    spec.process_voluntary_exit(state, first)
+    second = build_voluntary_exit(spec, state, 1)
+    yield from _run_op(spec, state, "voluntary_exit", second)
+    # under the churn limit both land on the same (default) exit epoch
+    assert state.validators[1].exit_epoch == state.validators[0].exit_epoch

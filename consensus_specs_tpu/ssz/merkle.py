@@ -110,6 +110,11 @@ class IncrementalTree:
             raise ValueError(f"IncrementalTree: {n} chunks exceeds limit {limit}")
         self.limit = limit
         self.levels = [bytearray(chunks_blob)]
+        if n > 1:
+            built = _native.build_tree_levels(bytes(chunks_blob))
+            if built is not None:
+                self.levels.extend(built)
+                return
         d = 0
         while len(self.levels[-1]) > 32:
             cur = self.levels[-1]

@@ -744,6 +744,31 @@ class _Sequence(SSZType):
     def _coerce_elems(self, elems):
         return [self.ELEM_TYPE.coerce(e) if not isinstance(e, self.ELEM_TYPE) else e for e in elems]
 
+    # --- columnar backing (basic element types) -----------------------------
+    # Registry-scale sequences of uints/booleans can be backed by a single
+    # numpy column (`_np`) instead of a list of boxed Python ints, making the
+    # engine bridge's per-epoch from_numpy/to_numpy round-trip an O(1) array
+    # adoption rather than a million-element boxing pass (engine/bridge.py).
+    # Boxed elements (`_list`) materialize lazily on first generic access and
+    # may coexist with the column because basic elements are immutable; every
+    # list-path mutation drops the column, and the in-place int __setitem__
+    # updates both. Invariant: when both are present they hold equal values.
+
+    @property
+    def _elems(self):
+        lst = self.__dict__.get("_list")
+        if lst is None:
+            col = self.__dict__.get("_np")
+            et = self.ELEM_TYPE
+            lst = [et(v) for v in col.tolist()] if col is not None else []
+            self.__dict__["_list"] = lst
+        return lst
+
+    @_elems.setter
+    def _elems(self, value):
+        self.__dict__["_list"] = value
+        self.__dict__["_np"] = None
+
     # --- incremental-merkleization bookkeeping ------------------------------
 
     @classmethod
@@ -788,6 +813,12 @@ class _Sequence(SSZType):
         et = self.ELEM_TYPE
         if _is_basic(et):
             per = BYTES_PER_CHUNK // et.type_byte_length()
+            col = self.__dict__.get("_np")
+            if col is not None:
+                seg = col[ci * per:(ci + 1) * per]
+                if len(seg) == 0:
+                    return None
+                return _pack_le_blob(seg, et.type_byte_length())
             seg = self._elems[ci * per:(ci + 1) * per]
             if not seg:
                 return None
@@ -802,10 +833,15 @@ class _Sequence(SSZType):
         (1M Python encode_bytes calls otherwise dominate cold builds);
         None when the element dtype has no numpy representation."""
         et = self.ELEM_TYPE
-        if len(self._elems) < 1024 or not _is_basic(et):
-            return None  # _is_basic first: variable-size types have no length
+        if not _is_basic(et):
+            return None
         size = et.type_byte_length()
         if size not in (1, 2, 4, 8):
+            return None
+        col = self.__dict__.get("_np")
+        if col is not None:
+            return _pack_le_blob(col, size)
+        if len(self._elems) < 1024:
             return None
         return _pack_le_blob(self.to_numpy(), size)
 
@@ -842,21 +878,39 @@ class _Sequence(SSZType):
                 blob, n_chunks if limit_chunks is None else limit_chunks)
             object.__setattr__(self, "_tree", tree)
             return tree.root()
-        # small sequence: chunks is always populated here (the fast-blob
-        # path implies >= 1024 elements and therefore >= _TREE_MIN_CHUNKS)
+        # small sequence (columnar ones can land here at any length)
+        if chunks is None:
+            chunks = [blob[i:i + BYTES_PER_CHUNK]
+                      for i in range(0, len(blob), BYTES_PER_CHUNK)]
         object.__setattr__(self, "_tree", None)
         return merkleize_chunks(chunks, limit=limit_chunks)
 
     def __len__(self):
-        return len(self._elems)
+        lst = self.__dict__.get("_list")
+        if lst is not None:
+            return len(lst)
+        col = self.__dict__.get("_np")
+        return len(col) if col is not None else 0
 
     def __iter__(self):
         return iter(self._elems)
 
     def __getitem__(self, i):
         if isinstance(i, slice):
+            lst = self.__dict__.get("_list")
+            if lst is None:
+                col = self.__dict__.get("_np")
+                if col is not None:
+                    et = self.ELEM_TYPE
+                    return [et(v) for v in col[i].tolist()]
             return self._elems[i]
-        return self._elems[i]
+        lst = self.__dict__.get("_list")
+        if lst is not None:
+            return lst[i]
+        col = self.__dict__.get("_np")
+        if col is not None:
+            return self.ELEM_TYPE(col[i].item())
+        raise IndexError(f"{type(self).__name__} index out of range")
 
     def __setitem__(self, i, v):
         if isinstance(i, slice):
@@ -870,9 +924,16 @@ class _Sequence(SSZType):
             self._mark_structural()
         else:
             value = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
-            self._elems[i] = value
+            lst = self.__dict__.get("_list")
+            col = self.__dict__.get("_np")
+            if lst is not None:
+                lst[i] = value
+            if col is not None:
+                col[i] = value  # keeps the column coherent with the list
+            if lst is None and col is None:
+                raise IndexError(f"{type(self).__name__} assignment index out of range")
             if i < 0:
-                i += len(self._elems)
+                i += len(self)
             ci = self._chunk_index(i)
             _attach(value, self, ci)
             self._note_dirty_chunk(ci)
@@ -886,7 +947,14 @@ class _Sequence(SSZType):
             # Spec code compares SSZ sequences against plain-list literals.
             return len(self._elems) == len(other) and all(
                 a == b for a, b in zip(self._elems, other))
-        return type(self) is type(other) and self._elems == other._elems
+        if type(self) is not type(other):
+            return False
+        a, b = self.__dict__.get("_np"), other.__dict__.get("_np")
+        if a is not None and b is not None:
+            import numpy as np
+
+            return bool(np.array_equal(a, b))
+        return self._elems == other._elems
 
     def __hash__(self):
         return hash((type(self).__name__, tuple(self._elems)))
@@ -920,6 +988,9 @@ class _Sequence(SSZType):
             dtype = _dtypes[et.type_byte_length()]
         else:
             raise TypeError(f"to_numpy: {et.__name__} has no numpy dtype")
+        col = self.__dict__.get("_np")
+        if col is not None:
+            return col.copy()
         return np.fromiter(self._elems, dtype=dtype, count=len(self._elems))
 
     @classmethod
@@ -942,30 +1013,86 @@ class _Sequence(SSZType):
 
     @classmethod
     def from_numpy(cls, arr):
-        """from_values + merkle-tree pre-seeding straight from the column's
-        bytes: the registry-scale write-back (engine/bridge) replaces whole
-        basic-element lists per epoch, and packing chunks from the numpy
-        buffer skips the million-call per-element encode pass the first
-        hash_tree_root would otherwise pay."""
+        """Adopt a numpy column as the sequence's backing storage — no
+        per-element boxing — and pre-seed the merkle tree straight from the
+        column's bytes. The registry-scale write-back (engine/bridge)
+        replaces whole basic-element lists per epoch; this makes that an
+        O(n) memcpy + one native hashing pass instead of a million-element
+        Python boxing pass."""
         import numpy as np
 
         et = cls.ELEM_TYPE
         if not _is_basic(et):
             raise TypeError("from_numpy: basic element types only")
         size = et.type_byte_length()
+        if size not in (1, 2, 4, 8):
+            raise TypeError(f"from_numpy: {et.__name__} has no numpy dtype")
         arr = np.ascontiguousarray(arr)
-        out = cls.from_values(arr.tolist())
-        blob = _pack_le_blob(arr, size)
-        if len(blob) // BYTES_PER_CHUNK >= _TREE_MIN_CHUNKS:
-            limit = out.chunk_limit() if hasattr(out, "chunk_limit") else out.chunk_count()
-            object.__setattr__(out, "_tree", IncrementalTree(blob, limit))
-            object.__setattr__(out, "_structural", False)
+        if issubclass(et, boolean):
+            if arr.dtype != np.bool_:
+                if arr.dtype.kind not in ("u", "i") or (
+                        len(arr) and int(arr.max()) > 1 or len(arr) and int(arr.min()) < 0):
+                    raise TypeError(f"cannot build {cls.__name__} from dtype {arr.dtype}")
+                arr = arr.astype(np.bool_)
+            col = np.array(arr, dtype=np.bool_)
+        else:
+            if arr.dtype.kind == "b":
+                # preserve from_values' bool rejection: a numpy bool column
+                # fed into a uint list must fail loudly
+                raise TypeError(f"cannot build {cls.__name__} from bools")
+            if arr.dtype.kind not in ("u", "i"):
+                raise TypeError(f"cannot build {cls.__name__} from dtype {arr.dtype}")
+            if len(arr):
+                lo, hi = int(arr.min()), int(arr.max())
+                if lo < 0 or hi >> (8 * size):
+                    raise OverflowError(
+                        f"{cls.__name__}: value out of range for {et.__name__}")
+            col = np.array(arr, dtype=f"u{size}")
+        out = cls.__new__(cls)
+        out.__dict__["_np"] = col
+        out.__dict__["_list"] = None
+        out._check_length(len(col))
+        # No eager tree seeding: _merkle_root's cold path packs the chunk
+        # blob straight from the column, so the IncrementalTree builds lazily
+        # on the first hash_tree_root — columns that are never hashed
+        # (intermediate bridge states) cost nothing.
         return out
+
+    @classmethod
+    def _decode_columnar(cls, data: bytes):
+        """Columnar decode for large basic-element payloads: one frombuffer
+        pass instead of len/size boxed `decode_bytes` calls (registry-scale
+        state loads). None when inapplicable; the caller falls back to the
+        per-element path."""
+        import numpy as np
+
+        et = cls.ELEM_TYPE
+        if not _is_basic(et):
+            return None
+        size = et.type_byte_length()
+        if size not in (1, 2, 4, 8) or len(data) < 1024 * size:
+            return None
+        if len(data) % size != 0:
+            raise ValueError(
+                f"{cls.__name__}: byte length {len(data)} not a multiple of {size}")
+        arr = np.frombuffer(data, dtype=f"<u{size}")
+        if issubclass(et, boolean):
+            if len(arr) and int(arr.max()) > 1:
+                raise ValueError(f"{cls.__name__}: invalid boolean byte")
+            arr = arr.astype(np.bool_)
+        return cls.from_numpy(arr)
 
     # --- shared serialization over self._elems ---
 
     def encode_bytes(self) -> bytes:
+        import numpy as np
+
         et = self.ELEM_TYPE
+        col = self.__dict__.get("_np")
+        if col is not None and _is_basic(et):
+            size = et.type_byte_length()
+            a = col.astype(np.uint8) if col.dtype == np.bool_ else col
+            return np.ascontiguousarray(a).astype(f"<u{size}", copy=False).tobytes()
         if et.is_fixed_size():
             return b"".join(e.encode_bytes() for e in self._elems)
         parts = [e.encode_bytes() for e in self._elems]
@@ -1066,6 +1193,9 @@ class Vector(_Sequence, metaclass=_ParamMeta):
 
     @classmethod
     def decode_bytes(cls, data: bytes):
+        fast = cls._decode_columnar(data)
+        if fast is not None:
+            return fast  # from_numpy already enforced LENGTH
         elems = cls._decode_elems(data)
         if len(elems) != cls.LENGTH:
             raise ValueError(f"{cls.__name__}: decoded {len(elems)} elements, expected {cls.LENGTH}")
@@ -1080,6 +1210,13 @@ class Vector(_Sequence, metaclass=_ParamMeta):
         return root
 
     def copy(self):
+        col = self.__dict__.get("_np")
+        if col is not None and self.__dict__.get("_list") is None:
+            new = type(self).__new__(type(self))
+            new.__dict__["_np"] = col.copy()
+            new.__dict__["_list"] = None
+            _copy_merkle_state(self, new)
+            return new
         new = type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
         _copy_merkle_state(self, new)
         return new
@@ -1125,6 +1262,9 @@ class List(_Sequence, metaclass=_ParamMeta):
 
     @classmethod
     def decode_bytes(cls, data: bytes):
+        fast = cls._decode_columnar(data)
+        if fast is not None:
+            return fast  # from_numpy already enforced LIMIT
         elems = cls._decode_elems(data)
         if len(elems) > cls.LIMIT:
             raise ValueError(f"{cls.__name__}: {len(elems)} elements exceeds limit")
@@ -1140,20 +1280,28 @@ class List(_Sequence, metaclass=_ParamMeta):
         cached = self.__dict__.get("_root_cache")
         if cached is not None:
             return cached
-        root = mix_in_length(self._merkle_root(self.chunk_limit()), len(self._elems))
+        root = mix_in_length(self._merkle_root(self.chunk_limit()), len(self))
         object.__setattr__(self, "_root_cache", root)
         return root
 
     def copy(self):
+        col = self.__dict__.get("_np")
+        if col is not None and self.__dict__.get("_list") is None:
+            new = type(self).__new__(type(self))
+            new.__dict__["_np"] = col.copy()
+            new.__dict__["_list"] = None
+            _copy_merkle_state(self, new)
+            return new
         new = type(self)([e.copy() if hasattr(e, "copy") else e for e in self._elems])
         _copy_merkle_state(self, new)
         return new
 
     def append(self, v):
-        if len(self._elems) >= self.LIMIT:
+        if len(self) >= self.LIMIT:
             raise ValueError(f"{type(self).__name__}: append past limit")
         value = v if isinstance(v, self.ELEM_TYPE) else self.ELEM_TYPE.coerce(v)
         self._elems.append(value)
+        self.__dict__["_np"] = None  # list path is now authoritative
         _attach(value, self, self._chunk_index(len(self._elems) - 1))
         self._mark_structural()
 
@@ -1161,6 +1309,7 @@ class List(_Sequence, metaclass=_ParamMeta):
         if not self._elems:
             raise IndexError("pop from empty List")
         value = self._elems.pop()
+        self.__dict__["_np"] = None  # list path is now authoritative
         self._mark_structural()
         return value
 

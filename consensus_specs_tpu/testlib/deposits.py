@@ -54,7 +54,8 @@ def prepare_genesis_deposits(spec, count, amount=None, signed=True):
     return deposits, spec.Root(tree.root())
 
 
-def build_deposit_for_index(spec, state, validator_index, amount=None, signed=True):
+def build_deposit_for_index(spec, state, validator_index, amount=None, signed=True,
+                            withdrawal_credentials=None):
     """One post-genesis deposit appended to a tree seeded with the state's
     existing deposit count (top-up when validator_index exists)."""
     amount = amount if amount is not None else spec.MAX_EFFECTIVE_BALANCE
@@ -63,12 +64,14 @@ def build_deposit_for_index(spec, state, validator_index, amount=None, signed=Tr
     # and proof line up with state.eth1_deposit_index
     for i in range(int(state.eth1_deposit_index)):
         tree.push(bytes(spec.hash_tree_root(spec.DepositData())))
+    if withdrawal_credentials is None:
+        withdrawal_credentials = default_withdrawal_credentials(spec, validator_index)
     data = build_deposit_data(
         spec,
         get_pubkeys()[validator_index],
         privkeys[validator_index],
         amount,
-        default_withdrawal_credentials(spec, validator_index),
+        withdrawal_credentials,
         signed=signed,
     )
     index = tree.deposit_count

@@ -1,0 +1,113 @@
+"""Batched KZG verification (crypto/kzg_batch.py) vs the per-item oracle.
+
+The batch path must accept exactly what N `verify_coset` /
+`verify_degree_proof` calls accept and reject any batch containing a
+tampered item (Schwartz-Zippel: false accept odds 2^-64 per run)."""
+import pytest
+
+from consensus_specs_tpu.crypto import das, kzg, kzg_batch
+
+M = 4  # points per sample (small for CPU-test speed)
+N_DATA = 8  # blob size -> n2 = 16, 4 samples per blob
+
+
+@pytest.fixture(scope="module")
+def setup():
+    return kzg.insecure_test_setup(32)
+
+
+@pytest.fixture(scope="module")
+def blobs(setup):
+    """Three blobs' worth of (commitment, shift, ys, proof) items."""
+    items = []
+    for b in range(3):
+        # pseudo-random data: affinely-related blobs share coset quotients
+        # (swap tests would be vacuous), so make each blob independent
+        data = [pow(5, 17 * b + 3 * i + 1, kzg.MODULUS) for i in range(N_DATA)]
+        commitment, samples = das.sample_data(setup, data, M, use_device=False)
+        cosets = das.sample_cosets(2 * N_DATA, M)
+        for s in samples:
+            shift, _ = cosets[s.index]
+            items.append((commitment, shift, list(s.values), s.proof))
+    return items
+
+
+def test_batch_accepts_valid_samples(setup, blobs):
+    assert kzg_batch.batch_verify_samples(setup, blobs, use_device=False)
+
+
+def test_batch_matches_per_item_oracle(setup, blobs):
+    for commitment, shift, ys, proof in blobs:
+        assert kzg.verify_coset(setup, commitment, shift, ys, proof)
+
+
+def test_batch_rejects_tampered_value(setup, blobs):
+    bad = [list(it) for it in blobs]
+    bad[5][2] = list(bad[5][2])
+    bad[5][2][1] = (bad[5][2][1] + 1) % kzg.MODULUS
+    assert not kzg_batch.batch_verify_samples(
+        setup, [tuple(it) for it in bad], use_device=False)
+
+
+def test_batch_rejects_swapped_proofs(setup, blobs):
+    # NOTE: within ONE blob of degree < 2m, all coset quotients coincide
+    # (P - I_k = (x^m - zm_k)·Σ x^j b_j, so Q is coset-independent) — an
+    # intra-blob swap is a no-op by algebra. Swap across blobs instead.
+    bad = [list(it) for it in blobs]
+    bad[0][3], bad[4][3] = bad[4][3], bad[0][3]  # blob 0 <-> blob 1
+    assert not kzg_batch.batch_verify_samples(
+        setup, [tuple(it) for it in bad], use_device=False)
+
+
+def test_batch_rejects_wrong_commitment(setup, blobs):
+    bad = [list(it) for it in blobs]
+    bad[2][0] = blobs[-1][0] if blobs[2][0] is not blobs[-1][0] else blobs[0][0]
+    # items 0-3 share blob 0's commitment; give item 2 blob 2's instead
+    bad[2][0] = blobs[-1][0]
+    assert not kzg_batch.batch_verify_samples(
+        setup, [tuple(it) for it in bad], use_device=False)
+
+
+def test_empty_batch_is_vacuously_true(setup):
+    assert kzg_batch.batch_verify_samples(setup, [], use_device=False)
+
+
+def test_hostile_shapes_reject_not_crash(setup, blobs):
+    c, shift, ys, proof = blobs[0]
+    assert not kzg_batch.batch_verify_samples(
+        setup, [(c, shift, [], proof)], use_device=False)
+    assert not kzg_batch.batch_verify_samples(
+        setup, [(c, shift, ys[:3], proof)], use_device=False)  # not a power of 2
+    assert not kzg_batch.batch_verify_samples(
+        setup, [(c, shift, ys, None)], use_device=False)  # identity proof
+    assert not kzg_batch.batch_verify_samples(
+        setup, [(c, shift, [kzg.MODULUS] + ys[1:], proof)], use_device=False)
+
+
+def test_degree_proof_batch(setup):
+    items = []
+    k = N_DATA  # claim deg < 8
+    for b in range(4):
+        coeffs = [(11 * b + i + 2) % kzg.MODULUS for i in range(N_DATA)]
+        commitment = kzg.commit(setup, coeffs)
+        dproof = kzg.prove_degree_bound(setup, coeffs, k)
+        items.append((commitment, dproof))
+        assert kzg.verify_degree_proof(setup, commitment, dproof, k)
+    assert kzg_batch.batch_verify_degree_proofs(setup, items, k, use_device=False)
+    bad = list(items)
+    bad[1] = (items[2][0], items[1][1])  # commitment/proof mismatch
+    assert not kzg_batch.batch_verify_degree_proofs(setup, bad, k, use_device=False)
+    # out-of-range bound claims reject
+    assert not kzg_batch.batch_verify_degree_proofs(setup, items, 0, use_device=False)
+    assert not kzg_batch.batch_verify_degree_proofs(
+        setup, items, setup.max_degree + 2, use_device=False)
+
+
+@pytest.mark.slow
+def test_device_path_agrees_with_host(setup, blobs):
+    assert kzg_batch.batch_verify_samples(setup, blobs, use_device=True)
+    bad = [list(it) for it in blobs]
+    bad[3][2] = list(bad[3][2])
+    bad[3][2][0] = (bad[3][2][0] + 5) % kzg.MODULUS
+    assert not kzg_batch.batch_verify_samples(
+        setup, [tuple(it) for it in bad], use_device=True)

@@ -1,0 +1,65 @@
+"""Hierarchical (dcn, data) mesh: the multi-host layout on virtual hosts.
+
+Factors the 8-device CPU mesh as 2 "hosts" x 4 devices and runs the full
+epoch program over the two-axis sharding — the identical GSPMD program a
+real pod compiles, minus the physical DCN (parallel/multihost.py's test
+stance). Bit-equality against single-device is the conformance bar, same
+as tests/test_mesh_epoch.py for the flat mesh.
+"""
+import jax
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.engine.epoch import epoch_fn_for
+from consensus_specs_tpu.engine.state import EpochConfig
+from consensus_specs_tpu.engine.synthetic import synthetic_epoch_state
+from consensus_specs_tpu.parallel import multihost
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return EpochConfig.from_spec(get_spec("altair", "minimal"))
+
+
+def test_initialize_single_host_is_noop():
+    assert multihost.initialize() is False
+    assert multihost.initialize(num_processes=1) is False
+
+
+def test_global_mesh_factoring():
+    mesh = multihost.global_epoch_mesh(n_hosts=2)
+    assert mesh.axis_names == (multihost.DCN_AXIS, multihost.ICI_AXIS)
+    assert mesh.devices.shape == (2, len(jax.devices()) // 2)
+    with pytest.raises(ValueError):
+        multihost.global_epoch_mesh(n_hosts=3)
+
+
+def test_hierarchical_epoch_bit_equal(cfg):
+    n = 64 * len(jax.devices())
+    state = synthetic_epoch_state(cfg, n=n, seed=7)
+    fn = epoch_fn_for(cfg)
+    ref_out, ref_aux = fn(state)
+
+    mesh = multihost.global_epoch_mesh(n_hosts=2)
+    sharded = multihost.shard_epoch_state_hierarchical(state, mesh)
+    out, aux = fn(sharded)
+    for name in ("balances", "inactivity_scores", "exit_epoch", "effective_balance"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, name)), np.asarray(getattr(ref_out, name)), err_msg=name)
+    assert int(aux.eth1_votes_reset) == int(ref_aux.eth1_votes_reset)
+
+
+def test_hierarchical_actually_spans_both_axes(cfg):
+    mesh = multihost.global_epoch_mesh(n_hosts=2)
+    sh = multihost.hierarchical_epoch_shardings(mesh)
+    spec = sh.balances.spec
+    assert tuple(spec) == ((multihost.DCN_AXIS, multihost.ICI_AXIS),)
+    n = 64 * len(jax.devices())
+    state = synthetic_epoch_state(cfg, n=n, seed=3)
+    sharded = multihost.shard_epoch_state_hierarchical(state, mesh)
+    # every device holds a 1/n_devices block of the registry
+    n_dev = len(jax.devices())
+    shards = sharded.balances.addressable_shards
+    assert len(shards) == n_dev
+    assert all(s.data.shape[0] == n // n_dev for s in shards)
