@@ -16,6 +16,7 @@ exponentiation per item.
 """
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 
 import numpy as np
@@ -25,7 +26,16 @@ from .hash_to_curve import hash_to_curve_g2
 from .bls12_381 import g2_from_bytes
 
 
-@lru_cache(maxsize=1 << 20)
+# Cache sizing: each entry holds the 48 compressed bytes plus an affine
+# point (two ~381-bit ints, ~0.5 KB with dict overhead), so a full cache
+# is ~0.5 GB at the 2^20 default — sized for a 1M-validator registry where
+# every pubkey recurs each epoch. Override for memory-constrained hosts
+# via CONSENSUS_TPU_PUBKEY_CACHE (power-of-two entry count); the cache is
+# keyed on raw bytes so shrinking it only costs re-decompression.
+_PUBKEY_CACHE_SIZE = int(os.environ.get("CONSENSUS_TPU_PUBKEY_CACHE", 1 << 20))
+
+
+@lru_cache(maxsize=_PUBKEY_CACHE_SIZE)
 def g1_from_bytes(data: bytes):
     """Memoized validated G1 decompression. A node sees the same validator
     pubkeys every epoch, and the r-subgroup check (a 255-bit scalar

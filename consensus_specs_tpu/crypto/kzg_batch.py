@@ -243,3 +243,24 @@ def batch_verify_degree_proofs(
     # e(A, G2) · e(B, [s^shift]G2) == 1, with the shared-G2 roles swapped
     # into the two-pairing helper's fixed shape: e(B', q2)·e(A', G2)
     return _check_two_pairings(b, setup.g2[setup.max_degree + 1 - k], a)
+
+
+def verify_samples_attributed(setup: KZGSetup, items, use_device: bool = True):
+    """Production entry point: batch first, per-item attribution on failure.
+
+    `batch_verify_samples` is deliberately stricter than N `verify_coset`
+    calls — an identity proof (legitimate when deg P < m), coset_shift = 0,
+    or mixed sample sizes reject the whole batch. A block importer must not
+    drop valid samples over that, so on ANY batch failure this re-checks
+    each item with the per-item oracle (`kzg.verify_coset`) and returns the
+    authoritative per-item verdicts. Returns (all_ok, verdicts) where
+    verdicts is None on the batch fast path (all true by construction).
+    """
+    items = list(items)
+    if batch_verify_samples(setup, items, use_device=use_device):
+        return True, None
+    verdicts = [
+        kzg.verify_coset(setup, commitment, shift, ys, proof)
+        for commitment, shift, ys, proof in items
+    ]
+    return all(verdicts), verdicts

@@ -166,18 +166,12 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict,
     vals.__dict__["_engine_cols"] = (vals.hash_tree_root(), pre_cols)
 
 
-def _rotate_sync_committees(spec, state) -> None:
-    """process_sync_committee_updates body, with the batched sampler.
-    Activity mask and effective balances come from the memoized registry
-    columns (two vectorized compares instead of two 1M-element Python
-    passes)."""
-    next_epoch = spec.get_current_epoch(state) + 1
-    cols = _cached_validator_columns(state.validators)
-    eff = cols["effective_balance"]
-    active = np.nonzero(
-        (cols["activation_epoch"] <= next_epoch)
-        & (next_epoch < cols["exit_epoch"]))[0].astype(np.uint64)
-    seed = spec.get_seed(state, spec.Epoch(next_epoch), spec.DOMAIN_SYNC_COMMITTEE)
+def install_next_sync_committee(spec, state, active, eff, seed: bytes) -> None:
+    """Shared tail of `process_sync_committee_updates`: sample the next
+    committee from (active indices, effective balances, seed) via the
+    batched sampler and rotate the state's committee fields. Both rotation
+    paths (host-column based below, device-column based in
+    engine/resident.py) delegate here so the sampling logic lives once."""
     indices = next_sync_committee_indices(
         active,
         eff,
@@ -191,6 +185,21 @@ def _rotate_sync_committees(spec, state) -> None:
     state.next_sync_committee = spec.SyncCommittee(
         pubkeys=pubkeys, aggregate_pubkey=spec.eth_aggregate_pubkeys(pubkeys)
     )
+
+
+def _rotate_sync_committees(spec, state) -> None:
+    """process_sync_committee_updates body, with the batched sampler.
+    Activity mask and effective balances come from the memoized registry
+    columns (two vectorized compares instead of two 1M-element Python
+    passes)."""
+    next_epoch = spec.get_current_epoch(state) + 1
+    cols = _cached_validator_columns(state.validators)
+    eff = cols["effective_balance"]
+    active = np.nonzero(
+        (cols["activation_epoch"] <= next_epoch)
+        & (next_epoch < cols["exit_epoch"]))[0].astype(np.uint64)
+    seed = spec.get_seed(state, spec.Epoch(next_epoch), spec.DOMAIN_SYNC_COMMITTEE)
+    install_next_sync_committee(spec, state, active, eff, bytes(seed))
 
 
 def apply_epoch_via_engine(spec, state, stage_timer=None) -> None:

@@ -1,0 +1,138 @@
+"""Device-resident multi-epoch engine: the steady-state epoch pipeline.
+
+`bridge.apply_epoch_via_engine` round-trips the full registry every epoch
+(transpose in, device epoch, write back) — correct as a drop-in
+`process_epoch`, but at 1M validators the two host crossings dominate the
+wall clock by ~100x over the device compute. A node does not need the SSZ
+object tree between consecutive epoch transitions; it needs it at sync /
+checkpoint / block-proposal boundaries. So keep the `EpochState` resident
+on device and cross the host boundary only when something host-visible
+happens:
+
+  per epoch (always)          three () bool aux flags + the slot mirror
+  per eth1 voting period      clear the host `eth1_data_votes` list (O(1))
+  per 256 epochs (mainnet)    32-byte historical-batch root (device merkle)
+  per sync-committee period   seed mix row (32 B) + three registry columns
+                              for the committee sampler
+  on materialize()            the one full write-back, amortized over the
+                              epochs since the last one
+
+Reference parity: this replaces the per-epoch cost of
+`process_epoch(state)` (specs/altair/beacon-chain.md) for a multi-epoch
+run; `materialize()` restores the exact `BeaconState` the sequential
+`apply_epoch_via_engine` loop produces — bit-equality is asserted by
+tests/test_resident_engine.py against that loop, which is itself
+differentially tested against the compiled spec.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import bridge
+from .epoch import historical_batch_root, make_epoch_fn
+from .state import EpochConfig
+
+
+@lru_cache(maxsize=None)
+def resident_step_fn_for(cfg: EpochConfig):
+    """jit `process_epoch` + the inter-epoch slot advance, input donated.
+
+    The spec calls `process_epoch` at the last slot of each epoch and
+    `process_slots` then advances the slot; consecutive transitions are
+    exactly SLOTS_PER_EPOCH apart, so the resident step folds the advance
+    into the same XLA program and the state never leaves HBM.
+    """
+    epoch_fn = make_epoch_fn(cfg, with_jit=False)
+
+    def step(st):
+        st, aux = epoch_fn(st)
+        return st.replace(slot=st.slot + jnp.uint64(cfg.slots_per_epoch)), aux
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+class ResidentEpochEngine:
+    """Runs epochs with the registry resident in device HBM.
+
+    Usage:
+        eng = ResidentEpochEngine(spec, state)   # one bridge-in
+        for _ in range(k):
+            eng.step_epoch()                     # device-only steady state
+        eng.materialize()                        # one write-back; `state`
+                                                 # now equals the sequential
+                                                 # engine loop's result
+
+    Between `step_epoch` calls the host `state` is STALE except for the
+    fields the epilogue owns (slot, eth1_data_votes, historical_roots,
+    sync committees) — read it only after `materialize()`.
+    """
+
+    def __init__(self, spec, state):
+        self.spec = spec
+        self.state = state
+        dev, cfg, cols = bridge.state_to_device_with_columns(spec, state)
+        self.cfg = cfg
+        self.dev = dev
+        self._pre_cols = cols
+        self._pre_mixes = np.asarray(dev.randao_mixes)
+        self._step = resident_step_fn_for(cfg)
+
+    def step_epoch(self) -> None:
+        """One epoch transition; host work is O(1) except on period
+        boundaries (see module docstring)."""
+        self.dev, aux = self._step(self.dev)
+        # Three () bools: the only unconditional device->host readout.
+        if bool(aux.eth1_votes_reset):
+            self.state.eth1_data_votes = type(self.state.eth1_data_votes)()
+        if bool(aux.historical_append):
+            root = bridge._words_to_root(
+                np.asarray(historical_batch_root(self.dev.block_roots, self.dev.state_roots))
+            )
+            self.state.historical_roots.append(self.spec.Root(root))
+        if bool(aux.sync_committee_update):
+            self._rotate_sync_committees_resident()
+        # Mirror the slot advance the jitted step applied on device.
+        self.state.slot += self.spec.SLOTS_PER_EPOCH
+
+    def _rotate_sync_committees_resident(self) -> None:
+        """`process_sync_committee_updates` against device-current data.
+
+        The host registry is stale here, so the sampler inputs come off the
+        device: three (N,) columns (~24 MB at 1M — once per
+        EPOCHS_PER_SYNC_COMMITTEE_PERIOD) and the 32-byte seed mix row.
+        Pubkeys are immutable per validator index, so they still come from
+        the host object tree. Matches bridge._rotate_sync_committees /
+        specs/altair/beacon-chain.md get_next_sync_committee.
+        """
+        spec, state, cfg = self.spec, self.state, self.cfg
+        # NOTE: the device slot has already advanced past the transition;
+        # the host mirror has not (step_epoch advances it after this call),
+        # so current_epoch/next_epoch come from the host slot.
+        next_epoch = state.slot // cfg.slots_per_epoch + 1
+        act = np.asarray(self.dev.activation_epoch)
+        exit_ = np.asarray(self.dev.exit_epoch)
+        eff = np.asarray(self.dev.effective_balance)
+        active = np.nonzero(
+            (act <= np.uint64(next_epoch)) & (np.uint64(next_epoch) < exit_)
+        )[0].astype(np.uint64)
+        # get_seed over the DEVICE randao mixes (the host rows are stale):
+        # hash(domain_type + uint_to_bytes(epoch) + mix)
+        mix_slot = (
+            int(next_epoch) + cfg.epochs_per_historical_vector - cfg.min_seed_lookahead - 1
+        ) % cfg.epochs_per_historical_vector
+        mix = bridge._words_to_root(np.asarray(self.dev.randao_mixes[mix_slot]))
+        seed = spec.hash(
+            bytes(spec.DOMAIN_SYNC_COMMITTEE) + spec.uint_to_bytes(spec.Epoch(next_epoch)) + mix
+        )
+        bridge.install_next_sync_committee(spec, state, active, eff, bytes(seed))
+
+    def materialize(self) -> None:
+        """Sync the host `BeaconState` to the device state: the one full
+        write-back, identical in effect to the per-epoch write-back of the
+        sequential loop (diff-based registry update + bulk vectors)."""
+        bridge._write_back(self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes)
+        self._pre_mixes = np.asarray(self.dev.randao_mixes)

@@ -111,3 +111,33 @@ def test_device_path_agrees_with_host(setup, blobs):
     bad[3][2][0] = (bad[3][2][0] + 5) % kzg.MODULUS
     assert not kzg_batch.batch_verify_samples(
         setup, [tuple(it) for it in bad], use_device=True)
+
+
+def test_attributed_fallback_on_strict_reject(setup, blobs):
+    """verify_samples_attributed rescues batches the strict batch path
+    rejects but the per-item oracle accepts (e.g. an identity proof from
+    deg P < m), and attributes genuine failures per item."""
+    ok, verdicts = kzg_batch.verify_samples_attributed(setup, blobs, use_device=False)
+    assert ok and verdicts is None  # fast path: no per-item pass needed
+
+    # deg P < m  ->  prove_coset returns the identity proof (None); the
+    # strict batch rejects it, the per-item oracle accepts it.
+    coeffs = [7] + [0] * (M - 1)  # constant polynomial: deg P < m
+    commitment = kzg.commit(setup, coeffs)
+    shift, _ = das.sample_cosets(2 * N_DATA, M)[0]
+    proof, ys = kzg.prove_coset(setup, coeffs, shift, M)
+    assert proof is None and ys == [7] * M  # identity proof
+    mixed = list(blobs) + [(commitment, shift, ys, proof)]
+    assert kzg.verify_coset(setup, commitment, shift, ys, proof)
+    assert not kzg_batch.batch_verify_samples(setup, mixed, use_device=False)
+    ok, verdicts = kzg_batch.verify_samples_attributed(setup, mixed, use_device=False)
+    assert ok and verdicts is not None and all(verdicts)
+
+    # a genuinely bad item is attributed, not masked by the fallback
+    bad = [list(it) for it in mixed]
+    bad[2][2] = list(bad[2][2])
+    bad[2][2][0] = (bad[2][2][0] + 1) % kzg.MODULUS
+    ok, verdicts = kzg_batch.verify_samples_attributed(
+        setup, [tuple(it) for it in bad], use_device=False)
+    assert not ok and verdicts is not None
+    assert verdicts[2] is False and sum(1 for v in verdicts if not v) == 1

@@ -36,12 +36,15 @@ def test_global_mesh_factoring():
 
 
 def test_hierarchical_epoch_bit_equal(cfg):
+    # epoch_fn_for jits with donate_argnums=(0,): the state passed to the
+    # reference run is consumed, so build a fresh (identical cfg/n/seed)
+    # state for the sharded run rather than reusing donated buffers.
     n = 64 * len(jax.devices())
-    state = synthetic_epoch_state(cfg, n=n, seed=7)
     fn = epoch_fn_for(cfg)
-    ref_out, ref_aux = fn(state)
+    ref_out, ref_aux = fn(synthetic_epoch_state(cfg, n=n, seed=7))
 
     mesh = multihost.global_epoch_mesh(n_hosts=2)
+    state = synthetic_epoch_state(cfg, n=n, seed=7)
     sharded = multihost.shard_epoch_state_hierarchical(state, mesh)
     out, aux = fn(sharded)
     for name in ("balances", "inactivity_scores", "exit_epoch", "effective_balance"):

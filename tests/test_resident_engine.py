@@ -1,0 +1,89 @@
+"""Device-resident multi-epoch engine vs the sequential bridge loop.
+
+`ResidentEpochEngine` (engine/resident.py) keeps the registry in device
+HBM across K epochs and syncs the host BeaconState once at the end; the
+sequential loop (`apply_epoch_via_engine` + host slot advance per epoch)
+round-trips every epoch and is itself differentially tested against the
+compiled spec (tests/test_epoch_engine.py). The two must produce
+SSZ-hash-identical states — including across eth1-reset, historical-append
+and sync-committee-rotation boundaries, whose epilogues the resident
+engine services from device-current data.
+"""
+import random
+
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.engine import bridge
+from consensus_specs_tpu.engine.resident import ResidentEpochEngine
+from consensus_specs_tpu.ssz import hash_tree_root
+from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+from consensus_specs_tpu.testlib.state import transition_to
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+def _prepared_state(spec, start_epoch: int, seed: int):
+    state = create_valid_beacon_state(spec)
+    transition_to(spec, state, spec.SlotNumber(start_epoch * spec.SLOTS_PER_EPOCH)
+                  if hasattr(spec, "SlotNumber") else start_epoch * spec.SLOTS_PER_EPOCH)
+    # land on the last slot of start_epoch: the slot process_epoch runs at
+    state.slot = spec.Slot((start_epoch + 1) * spec.SLOTS_PER_EPOCH - 1)
+    rng = random.Random(seed)
+    for i in range(len(state.validators)):
+        state.balances[i] = spec.Gwei(rng.randrange(16_000_000_000, 40_000_000_000))
+        state.previous_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.current_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.inactivity_scores[i] = spec.uint64(rng.randrange(0, 100))
+    cur = spec.get_current_epoch(state)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(max(0, int(cur) - 2)), root=state.finalized_checkpoint.root)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(max(0, int(cur) - 1)), root=state.current_justified_checkpoint.root)
+    return state
+
+
+@pytest.mark.parametrize("k_epochs", [3, 9])
+def test_resident_matches_sequential_loop(spec, k_epochs):
+    """k=9 from epoch 6 crosses (minimal preset): eth1 reset (period 4),
+    historical append (every 8 epochs), and a sync-committee rotation
+    (period 8) — every epilogue the resident engine services lazily."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        seq = _prepared_state(spec, start_epoch=6, seed=11)
+        res = seq.copy()
+
+        for _ in range(k_epochs):
+            bridge.apply_epoch_via_engine(spec, seq)
+            seq.slot += spec.SLOTS_PER_EPOCH
+
+        eng = ResidentEpochEngine(spec, res)
+        for _ in range(k_epochs):
+            eng.step_epoch()
+        eng.materialize()
+
+        assert int(res.slot) == int(seq.slot)
+        assert bytes(hash_tree_root(res)) == bytes(hash_tree_root(seq))
+    finally:
+        bls.bls_active = was
+
+
+def test_resident_state_stale_until_materialize(spec):
+    """The documented contract: registry fields lag until materialize()."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = _prepared_state(spec, start_epoch=6, seed=3)
+        before = [int(b) for b in st.balances]
+        eng = ResidentEpochEngine(spec, st)
+        eng.step_epoch()
+        assert [int(b) for b in st.balances] == before  # untouched host copy
+        eng.materialize()
+        assert [int(b) for b in st.balances] != before  # rewards applied
+    finally:
+        bls.bls_active = was
