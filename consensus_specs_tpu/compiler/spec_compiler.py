@@ -30,6 +30,7 @@ FORK_DOCS = {
         "phase0/fork-choice.md",
         "phase0/validator.md",
         "phase0/weak-subjectivity.md",
+        "phase0/p2p-interface.md",
     ],
     "altair": [
         "altair/beacon-chain.md",
@@ -37,6 +38,10 @@ FORK_DOCS = {
         "altair/fork.md",
         "altair/sync-protocol.md",
         "altair/validator.md",
+        # networking overlay last: MetaData v2 + the sync-subcommittee
+        # helpers are spec functions (reference setup.py compiles
+        # p2p-interface.md into the altair spec the same way)
+        "altair/p2p-interface.md",
     ],
     "bellatrix": [
         "bellatrix/beacon-chain.md",
@@ -52,9 +57,11 @@ FORK_DOCS = {
     # same compiled-vs-default split the reference makes).
     "sharding": [
         "sharding/beacon-chain.md",
+        "sharding/p2p-interface.md",
     ],
     "custody_game": [
         "custody_game/beacon-chain.md",
+        "custody_game/validator.md",
     ],
 }
 FORK_ORDER = ["phase0", "altair", "bellatrix", "sharding", "custody_game"]
@@ -66,7 +73,9 @@ PREVIOUS_FORK = {
     "custody_game": "sharding",
 }
 
-_CONST_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+# Two+ chars: single-letter table rows (gossipsub tuning parameters like
+# `D` in the p2p docs) are protocol documentation, not spec constants.
+_CONST_RE = re.compile(r"^[A-Z][A-Z0-9_]+$")
 _SKIP_DIRECTIVE = "<!-- spec: skip -->"
 
 

@@ -33,13 +33,35 @@ import struct
 import threading
 from dataclasses import dataclass, field
 
+MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 _LEN = struct.Struct("<I")
 
 
 def message_id(ssz_bytes: bytes) -> bytes:
-    """20-byte gossip message-id (p2p-interface.md gossip domain)."""
+    """20-byte phase0 gossip message-id (specs/phase0/p2p-interface.md):
+    domain ‖ decompressed data, no topic binding."""
     return hashlib.sha256(MESSAGE_DOMAIN_VALID_SNAPPY + ssz_bytes).digest()[:20]
+
+
+def message_id_v2(topic: bytes, data: bytes) -> bytes:
+    """Topic-aware altair message-id (specs/altair/p2p-interface.md):
+    the topic (length-prefixed, little-endian uint64) is mixed into the
+    hash, so identical payloads on two topics get distinct ids — the
+    cross-topic seen-cache poisoning phase0's derivation admits is closed.
+    `data` is the raw wire payload; the VALID domain + decompressed bytes
+    are hashed when it is valid snappy, the INVALID domain + raw bytes
+    otherwise."""
+    from ..native.snappy import decompress
+
+    prefix = len(topic).to_bytes(8, "little") + topic
+    try:
+        payload = decompress(data)
+        domain = MESSAGE_DOMAIN_VALID_SNAPPY
+    except Exception:
+        payload = data
+        domain = MESSAGE_DOMAIN_INVALID_SNAPPY
+    return hashlib.sha256(domain + prefix + payload).digest()[:20]
 
 
 def encode_message(ssz_bytes: bytes) -> bytes:

@@ -8,7 +8,7 @@ genesis.ssz_snappy + is_valid.yaml.
 Reference parity: test/phase0/genesis/test_initialization.py,
 test_validity.py.
 """
-from ..testlib.context import PHASE0, spec_test, with_phases
+from ..testlib.context import ALTAIR, PHASE0, spec_test, with_phases
 from ..testlib.deposits import prepare_genesis_deposits
 
 ETH1_BLOCK_HASH = b"\x12" * 32
@@ -89,3 +89,30 @@ def test_validity_too_early(spec):
     valid = spec.is_valid_genesis_state(state)
     assert not valid
     yield "is_valid", "data", bool(valid)
+
+
+@with_phases([ALTAIR])
+@spec_test
+
+def test_initialize_beacon_state_from_eth1_altair(spec):
+    """Altair override: fork carries ALTAIR_FORK_VERSION on both sides and
+    genesis sync committees are sampled (the SAME committee twice)."""
+    deposits, deposit_root = prepare_genesis_deposits(spec, _min_count(spec))
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + ETH1_BLOCK_HASH.hex(),
+        "eth1_timestamp": ETH1_TIMESTAMP,
+    }
+    yield "meta", "meta", {"deposits_count": len(deposits)}
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    assert state.eth1_data.deposit_root == deposit_root
+    assert bytes(state.fork.current_version) == bytes(spec.config.ALTAIR_FORK_VERSION)
+    assert bytes(state.fork.previous_version) == bytes(spec.config.ALTAIR_FORK_VERSION)
+    expected = spec.get_next_sync_committee(state)
+    assert bytes(state.current_sync_committee.hash_tree_root()) == bytes(expected.hash_tree_root())
+    assert bytes(state.next_sync_committee.hash_tree_root()) == bytes(expected.hash_tree_root())
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state

@@ -1,0 +1,134 @@
+"""Dual-mode tests for the compiled networking helpers.
+
+The p2p documents compile into the executable spec (spec_compiler FORK_DOCS
+wires phase0/altair p2p-interface.md), so their helper functions are spec
+functions with testable invariants: subnet derivations, the sync
+subcommittee membership slices, and the MetaData shapes.
+
+Reference parity: the reference compiles `get_sync_subcommittee_pubkeys`
+and `compute_subnets_for_sync_committee` from its altair p2p/validator docs
+(setup.py altair source list) and exercises them via
+test/altair/unittests/validator/ — these bodies are the equivalent layer.
+"""
+from ..testlib.context import (
+    ALTAIR,
+    BELLATRIX,
+    PHASE0,
+    spec_state_test,
+    spec_test,
+    with_all_phases,
+    with_all_phases_except,
+    with_phases,
+)
+from ..testlib.state import transition_to
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_for_attestation_in_range(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    start = int(spec.compute_start_slot_at_epoch(epoch))
+    for slot in (start, start + 1, start + int(spec.SLOTS_PER_EPOCH) - 1):
+        for index in range(int(committees_per_slot)):
+            subnet = spec.compute_subnet_for_attestation(
+                committees_per_slot, spec.Slot(slot), spec.CommitteeIndex(index))
+            assert 0 <= int(subnet) < int(spec.ATTESTATION_SUBNET_COUNT)
+    # distinct (slot-in-epoch, committee) pairs map to distinct subnets as
+    # long as the epoch's committee total fits the subnet count
+    total = int(committees_per_slot) * int(spec.SLOTS_PER_EPOCH)
+    if total <= int(spec.ATTESTATION_SUBNET_COUNT):
+        seen = {
+            int(spec.compute_subnet_for_attestation(
+                committees_per_slot, spec.Slot(start + off), spec.CommitteeIndex(i)))
+            for off in range(int(spec.SLOTS_PER_EPOCH))
+            for i in range(int(committees_per_slot))
+        }
+        assert len(seen) == total
+
+
+@with_phases([PHASE0])
+@spec_test
+def test_metadata_phase0_shape(spec):
+    md = spec.MetaData()
+    assert int(md.seq_number) == 0
+    assert len(md.attnets) == int(spec.ATTESTATION_SUBNET_COUNT)
+    assert not hasattr(md, "syncnets")
+
+
+@with_all_phases_except([PHASE0])
+@spec_test
+def test_metadata_altair_adds_syncnets(spec):
+    md = spec.MetaData()
+    assert len(md.attnets) == int(spec.ATTESTATION_SUBNET_COUNT)
+    assert len(md.syncnets) == int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    # the v2 container is a strict append: phase0 byte prefix is preserved
+    import consensus_specs_tpu.ssz as ssz
+
+    v2 = ssz.serialize(md)
+    v1_len = len(ssz.serialize(spec.uint64(0))) + len(md.attnets) // 8
+    assert v2[:v1_len] == b"\x00" * v1_len
+
+
+@with_all_phases_except([PHASE0])
+@spec_state_test
+def test_sync_subcommittee_pubkeys_partition(spec, state):
+    """The subcommittee slices tile the full committee in order."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    subnets = int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    tiled = []
+    for i in range(subnets):
+        sub = spec.get_sync_subcommittee_pubkeys(state, spec.uint64(i))
+        assert len(sub) == size // subnets
+        tiled.extend(bytes(pk) for pk in sub)
+    assert tiled == [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+
+
+@with_all_phases_except([PHASE0])
+@spec_state_test
+def test_sync_subcommittee_period_boundary_uses_next(spec, state):
+    """Committees assigned to a slot sign for slot-1: at the last slot of a
+    period the NEXT committee is the membership object."""
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    last_slot = period_epochs * int(spec.SLOTS_PER_EPOCH) - 1
+    transition_to(spec, state, spec.Slot(last_slot))
+    sub = spec.get_sync_subcommittee_pubkeys(state, spec.uint64(0))
+    expected = state.next_sync_committee.pubkeys[: len(sub)]
+    assert [bytes(p) for p in sub] == [bytes(p) for p in expected]
+    # one slot earlier, still mid-period: current committee
+    state2_slot = last_slot - 1
+    assert spec.compute_sync_committee_period(
+        spec.compute_epoch_at_slot(spec.Slot(state2_slot))
+    ) == spec.compute_sync_committee_period(
+        spec.compute_epoch_at_slot(spec.Slot(state2_slot + 1)))
+
+
+@with_all_phases_except([PHASE0])
+@spec_state_test
+def test_subnets_match_subcommittee_membership(spec, state):
+    """compute_subnets_for_sync_committee(v) is exactly the set of
+    subcommittees whose pubkey slice contains v's pubkey."""
+    subnets = int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    slices = [
+        [bytes(p) for p in spec.get_sync_subcommittee_pubkeys(state, spec.uint64(i))]
+        for i in range(subnets)
+    ]
+    committee_pubkeys = {bytes(p) for p in state.current_sync_committee.pubkeys}
+    checked = 0
+    for v in range(len(state.validators)):
+        pk = bytes(state.validators[v].pubkey)
+        if pk not in committee_pubkeys:
+            continue
+        got = spec.compute_subnets_for_sync_committee(state, spec.ValidatorIndex(v))
+        expected = {i for i in range(subnets) if pk in slices[i]}
+        assert set(int(s) for s in got) == expected
+        checked += 1
+    assert checked > 0
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_test
+def test_sync_committee_period_is_epoch_quotient(spec):
+    per = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    for epoch in (0, 1, per - 1, per, 2 * per + 3):
+        assert int(spec.compute_sync_committee_period(spec.Epoch(epoch))) == epoch // per

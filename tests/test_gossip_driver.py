@@ -106,3 +106,43 @@ def test_process_cluster_convergence():
     digests = {r[3] for r in reports}
     assert counts == {32}, f"non-converged counts: {sorted(r[:2] for r in reports)}"
     assert len(digests) == 1, "processes hold different message sets"
+
+
+def test_message_id_v2_is_topic_bound():
+    """Altair message-id (specs/altair/p2p-interface.md): same payload on
+    two topics -> distinct ids; phase0 and altair derivations differ even
+    on the same topic; valid/invalid snappy take different domains."""
+    from consensus_specs_tpu.native.snappy import compress
+    from consensus_specs_tpu.parallel.gossip_driver import (
+        MESSAGE_DOMAIN_INVALID_SNAPPY,
+        MESSAGE_DOMAIN_VALID_SNAPPY,
+        message_id,
+        message_id_v2,
+    )
+    import hashlib
+
+    payload = b"identical attestation bytes"
+    wire = compress(payload)
+    t_phase0 = b"/eth2/00000000/beacon_attestation_3/ssz_snappy"
+    t_altair = b"/eth2/01010101/beacon_attestation_3/ssz_snappy"
+
+    id_a = message_id_v2(t_phase0, wire)
+    id_b = message_id_v2(t_altair, wire)
+    assert id_a != id_b  # topic-bound: no cross-topic dedup
+    assert len(id_a) == len(id_b) == 20
+    # deterministic and distinct from the phase0 (topic-free) derivation
+    assert id_a == message_id_v2(t_phase0, wire)
+    assert message_id(payload) != id_a
+    # spec formula, spelled out
+    expected = hashlib.sha256(
+        MESSAGE_DOMAIN_VALID_SNAPPY
+        + len(t_altair).to_bytes(8, "little") + t_altair + payload
+    ).digest()[:20]
+    assert id_b == expected
+    # invalid snappy: INVALID domain over the raw wire bytes
+    junk = b"\xff not snappy at all"
+    expected_inv = hashlib.sha256(
+        MESSAGE_DOMAIN_INVALID_SNAPPY
+        + len(t_altair).to_bytes(8, "little") + t_altair + junk
+    ).digest()[:20]
+    assert message_id_v2(t_altair, junk) == expected_inv

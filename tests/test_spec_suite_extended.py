@@ -24,6 +24,7 @@ from consensus_specs_tpu.spec_tests.operations_extended import *  # noqa: E402,F
 from consensus_specs_tpu.spec_tests.fork_choice import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.forks import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.genesis import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.p2p import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.random_gen import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.rewards import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.transition import *  # noqa: E402,F401,F403
