@@ -217,7 +217,20 @@ def Aggregate(signatures) -> bytes:
 
 @only_with_bls(alt_return=STUB_SIGNATURE)
 def Sign(privkey, message) -> bytes:
-    return _py.Sign(int(privkey), message)
+    # Memoized: signing is deterministic ([sk]·H(m)), so caching is
+    # semantics-free; the vector-generator lane re-signs the same
+    # (privkey, root) pairs constantly (cached genesis states, randao
+    # reveals over the same epochs, selection proofs), and each pure-Python
+    # G2 scalar mul costs ~10 ms. ~200 B/entry -> 2^16 cap < ~15 MB.
+    return _sign_lru(int(privkey), bytes(message))
+
+
+from functools import lru_cache as _lru_cache
+
+
+@_lru_cache(maxsize=1 << 16)
+def _sign_lru(privkey: int, message: bytes) -> bytes:
+    return _py.Sign(privkey, message)
 
 
 @only_with_bls(alt_return=STUB_COORDINATES)

@@ -93,6 +93,24 @@ def run_state_test_generators(
 
     def prepare():
         bls.bls_active = True
+        # CONSENSUS_TPU_GEN_BLS=jax: verify through the batched XLA pairing
+        # backend instead of the pure-Python oracle — the reference's
+        # generators make the same move (milagro on CI, gen.py:75-77),
+        # because host-oracle pairings at ~1.5 s each make block-rich
+        # suites (sanity, finality) generation-bound. With the persistent
+        # compile cache the bucketed flush shapes compile once per machine.
+        import os
+
+        if os.environ.get("CONSENSUS_TPU_GEN_BLS") == "jax":
+            # force_cpu, not JAX_PLATFORMS: an accelerator sitecustomize
+            # freezes jax_platforms before env vars are consulted, and a
+            # dead tunnel makes the first devices() call hang — the
+            # plugin-factory drop in force_cpu is the only reliable pin.
+            from ..utils.backend import enable_compile_cache, force_cpu
+
+            force_cpu()
+            enable_compile_cache()
+            bls.use_jax()
 
     raise SystemExit(
         run_generator(runner_name, [TestProvider(make_cases=make_cases, prepare=prepare)])

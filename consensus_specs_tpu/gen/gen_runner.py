@@ -75,6 +75,12 @@ def run_generator(generator_name: str, providers: list[TestProvider], args=None)
     parser.add_argument("-f", "--force", action="store_true", help="regenerate existing cases")
     parser.add_argument("--preset-list", nargs="*", default=None)
     parser.add_argument("--fork-list", nargs="*", default=None)
+    parser.add_argument(
+        "--smoke", type=int, default=None, metavar="N",
+        help="stop after N cases have been generated or failed — the "
+             "default-lane health probe (tests/test_generator_smoke.py) "
+             "that bounds every generator's wall-clock",
+    )
     ns = parser.parse_args(args)
 
     output_dir = Path(ns.output_dir)
@@ -108,6 +114,10 @@ def run_generator(generator_name: str, providers: list[TestProvider], args=None)
             elapsed = time.time() - t0
             if elapsed > TIME_THRESHOLD_TO_PRINT:
                 print(f"[slow] {case.path}: {elapsed:.1f}s")
+            if ns.smoke is not None and generated + failed >= ns.smoke:
+                break
+        if ns.smoke is not None and generated + failed >= ns.smoke:
+            break
 
     if log:
         output_dir.mkdir(parents=True, exist_ok=True)

@@ -5,7 +5,11 @@ anchor_block ssz, per-object block_*/attestation_* ssz, steps.yaml of
 {tick|block|attestation|checks} entries. Reference parity:
 test/phase0/fork_choice/test_get_head.py, test_on_block.py scenarios.
 """
-from ..testlib.attestations import get_valid_attestation, sign_attestation
+from ..testlib.attestations import (
+    get_valid_attestation,
+    get_valid_attestations_at_slot,
+    sign_attestation,
+)
 from ..testlib.block import build_empty_block, state_transition_and_sign_block
 from ..testlib.context import spec_state_test, with_all_phases
 from ..testlib.fork_choice import (
@@ -385,4 +389,144 @@ def test_justification_updates_store_via_on_block(spec, state):
     add_checks_step(spec, store, steps)
     assert int(store.justified_checkpoint.epoch) >= 1, (
         "three attested epochs must justify at least epoch 1")
+    yield from finalize_steps(parts, steps)
+
+
+# --- ex-ante reorg scenarios -------------------------------------------------
+# Reference parity: test/phase0/fork_choice/test_ex_ante.py — proposer boost
+# as the defense against ex-ante reorgs: an adversary with a withheld block
+# (and k attestations) against an honest timely proposal.
+
+
+def _two_children_of(spec, state, parent_slot, attacker_slot, honest_slot):
+    """Common setup: a chain head at `parent_slot`, then an attacker block at
+    `attacker_slot` and an honest block at `honest_slot`, both children of
+    the parent. Returns (signed_parent, signed_attacker, state_attacker,
+    signed_honest)."""
+    block_p = build_empty_block(spec, state, spec.Slot(parent_slot))
+    signed_p = state_transition_and_sign_block(spec, state, block_p)
+
+    state_att = state.copy()
+    block_att = build_empty_block(spec, state_att, spec.Slot(attacker_slot))
+    block_att.body.graffiti = spec.Bytes32(b"\xaa" * 32)
+    signed_att = state_transition_and_sign_block(spec, state_att, block_att)
+
+    state_hon = state.copy()
+    block_hon = build_empty_block(spec, state_hon, spec.Slot(honest_slot))
+    block_hon.body.graffiti = spec.Bytes32(b"\x88" * 32)
+    signed_hon = state_transition_and_sign_block(spec, state_hon, block_hon)
+    return signed_p, signed_att, state_att, signed_hon
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_vanilla(spec, state):
+    """Withheld block at n+1 + ONE attacker attestation vs honest timely
+    block at n+2: proposer boost outweighs the single vote — head stays
+    with the honest block."""
+    store, parts, steps = initialize_steps(spec, state)
+    signed_p, signed_att, state_att, signed_hon = _two_children_of(spec, state, 1, 2, 3)
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed_p)
+
+    # attacker attests its withheld block at n+1
+    att = get_valid_attestation(
+        spec, state_att, slot=spec.Slot(2), signed=True,
+        filter_participant_set=lambda p: {min(p)})
+    tick_to_slot_step(spec, store, steps, 3)
+    add_block_step(spec, store, parts, steps, signed_hon)  # timely -> boost
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_hon.message.hash_tree_root()
+
+    add_block_step(spec, store, parts, steps, signed_att)  # released late
+    add_attestation_step(spec, store, parts, steps, att)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_hon.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_attestations_outweigh_boost(spec, state):
+    """Same shape, but the attacker ships a FULL slot committee of votes:
+    attestation weight now exceeds the proposer boost and the reorg wins."""
+    store, parts, steps = initialize_steps(spec, state)
+    signed_p, signed_att, state_att, signed_hon = _two_children_of(spec, state, 1, 2, 3)
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed_p)
+
+    atts = get_valid_attestations_at_slot(spec, state_att, spec.Slot(2), signed=True)
+    tick_to_slot_step(spec, store, steps, 3)
+    add_block_step(spec, store, parts, steps, signed_hon)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_hon.message.hash_tree_root()
+
+    add_block_step(spec, store, parts, steps, signed_att)
+    for att in atts:
+        add_attestation_step(spec, store, parts, steps, att)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_att.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """Boost-sandwich: attacker withholds block_b (n+1), honest block_c
+    (n+2) gets boosted, attacker releases b AND a child d (n+3) which
+    earns the boost at its own slot — the sandwich wins with zero votes."""
+    store, parts, steps = initialize_steps(spec, state)
+    signed_p, signed_b, state_b, signed_c = _two_children_of(spec, state, 1, 2, 3)
+    block_d = build_empty_block(spec, state_b, spec.Slot(4))
+    block_d.body.graffiti = spec.Bytes32(b"\xdd" * 32)
+    signed_d = state_transition_and_sign_block(spec, state_b, block_d)
+
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed_p)
+    tick_to_slot_step(spec, store, steps, 3)
+    add_block_step(spec, store, parts, steps, signed_c)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_c.message.hash_tree_root()
+
+    tick_to_slot_step(spec, store, steps, 4)
+    add_block_step(spec, store, parts, steps, signed_b)
+    add_block_step(spec, store, parts, steps, signed_d)  # timely at n+3 -> boost
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_d.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_sandwich_with_honest_attestation(spec, state):
+    """One honest vote for block_c breaks the zero-vote sandwich: after
+    d's boost expires (next slot tick), c's branch outweighs d's."""
+    store, parts, steps = initialize_steps(spec, state)
+    signed_p, signed_b, state_b, signed_c = _two_children_of(spec, state, 1, 2, 3)
+    block_d = build_empty_block(spec, state_b, spec.Slot(4))
+    block_d.body.graffiti = spec.Bytes32(b"\xdd" * 32)
+    signed_d = state_transition_and_sign_block(spec, state_b, block_d)
+
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed_p)
+    tick_to_slot_step(spec, store, steps, 3)
+    add_block_step(spec, store, parts, steps, signed_c)
+
+    # honest attestation to c at its own slot (one participant)
+    store_state_c = store.block_states[signed_c.message.hash_tree_root()]
+    att_c = get_valid_attestation(
+        spec, store_state_c.copy(), slot=spec.Slot(3), signed=True,
+        filter_participant_set=lambda p: {min(p)})
+
+    tick_to_slot_step(spec, store, steps, 4)
+    add_attestation_step(spec, store, parts, steps, att_c)
+    add_block_step(spec, store, parts, steps, signed_b)
+    add_block_step(spec, store, parts, steps, signed_d)
+    # d holds the head while boosted...
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_d.message.hash_tree_root()
+    # ...but the boost dies at the next slot tick and c's vote decides
+    tick_to_slot_step(spec, store, steps, 5)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed_c.message.hash_tree_root()
     yield from finalize_steps(parts, steps)

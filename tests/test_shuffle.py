@@ -53,3 +53,23 @@ def test_spec_committee_path_device_equals_scalar(monkeypatch):
     monkeypatch.setenv("CONSENSUS_TPU_HOST_SHUFFLE", "1")
     host_map = spec_host._get_shuffled_index_map(spec_host.uint64(n), spec_host.Bytes32(seed))
     assert list(dev_map) == list(host_map)
+
+
+def test_numpy_twin_matches_kernel_and_spec():
+    """compute_shuffled_indices_np (the generator lane's compile-free path)
+    is bit-identical to the device kernel across bucket-boundary shapes."""
+    import hashlib
+
+    import numpy as np
+
+    from consensus_specs_tpu.ops.shuffle import (
+        compute_shuffled_indices,
+        compute_shuffled_indices_np,
+    )
+
+    for n in (1, 2, 21, 255, 256, 257, 700):
+        seed = hashlib.sha256(n.to_bytes(4, "little")).digest()
+        kern = np.asarray(compute_shuffled_indices(n, seed, 10))
+        twin = compute_shuffled_indices_np(n, seed, 10)
+        assert np.array_equal(kern, twin), n
+    assert compute_shuffled_indices_np(0, b"\x00" * 32, 10).shape == (0,)
