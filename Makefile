@@ -12,7 +12,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
 
 .PHONY: test testall citest testfast lint pyspec generate_tests clean_vectors \
         detect_generator_incomplete bench bench_quick graft_check native replay \
-        random_codegen
+        random_codegen coverage
 
 # Default developer loop: full suite (minimal preset, BLS stubbed where the
 # suite chooses; JAX pinned to the virtual 8-device CPU mesh by tests/conftest.py).
@@ -101,3 +101,10 @@ graft_check:
 	from consensus_specs_tpu.utils.backend import force_cpu; force_cpu(8); \
 	import __graft_entry__ as g; fn, args = g.entry(); fn(*args); \
 	g.dryrun_multichip(8); print('graft entry ok')"
+
+# Line coverage over consensus_specs_tpu via stdlib sys.monitoring
+# (tools/coverage.py — the environment has no pytest-cov; reference
+# gates with --cov, Makefile:100). COVERAGE_MIN gates the build.
+COVERAGE_MIN ?= 85
+coverage:
+	$(PYTHON) tools/coverage.py --min $(COVERAGE_MIN) -- -m pytest tests/ -q -m "not slow"

@@ -406,3 +406,479 @@ def test_eth1_vote_no_reset_mid_period(spec, state):
     pre_votes = len(state.eth1_data_votes)
     yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
     assert len(state.eth1_data_votes) == pre_votes
+
+
+# --- justification & finalization support matrix ----------------------------
+# Reference parity: test/phase0/epoch_processing/
+# test_process_justification_and_finalization.py (12/23/123/234 rule
+# scenarios, ok/poor support, messed target, exited-balance threshold).
+
+
+def _set_target_support(spec, state, epoch, fraction, wrong_root=False):
+    """Give `fraction` of the active stake a matching-target credit for
+    `epoch` (phase0: PendingAttestations; altair+: target flags)."""
+    active = [int(v) for v in spec.get_active_validator_indices(state, spec.Epoch(epoch))]
+    k = int(len(active) * fraction)
+    if hasattr(state, "previous_epoch_participation"):
+        is_current = int(epoch) == int(spec.get_current_epoch(state))
+        col = (state.current_epoch_participation if is_current
+               else state.previous_epoch_participation)
+        flag = spec.ParticipationFlags(0)
+        flag = spec.add_flag(flag, spec.TIMELY_TARGET_FLAG_INDEX)
+        for i, v in enumerate(active):
+            col[v] = flag if i < k else spec.ParticipationFlags(0)
+        return
+    # phase0: one synthetic aggregate per committee, bits on for the first
+    # k validators encountered in committee order
+    is_current = int(epoch) == int(spec.get_current_epoch(state))
+    target_list = (state.current_epoch_attestations if is_current
+                   else state.previous_epoch_attestations)
+    source = (state.current_justified_checkpoint if is_current
+              else state.previous_justified_checkpoint)
+    target_root = (spec.Root(b"\x99" * 32) if wrong_root
+                   else spec.get_block_root(state, spec.Epoch(epoch)))
+    start_slot = int(spec.compute_start_slot_at_epoch(spec.Epoch(epoch)))
+    committees_per_slot = int(spec.get_committee_count_per_slot(state, spec.Epoch(epoch)))
+    credited = 0
+    for slot in range(start_slot, min(start_slot + int(spec.SLOTS_PER_EPOCH), int(state.slot))):
+        for index in range(committees_per_slot):
+            committee = spec.get_beacon_committee(
+                state, spec.Slot(slot), spec.CommitteeIndex(index))
+            bits = []
+            for _ in committee:
+                bits.append(credited < k)
+                credited += 1 if credited < k else 0
+            target_list.append(spec.PendingAttestation(
+                aggregation_bits=bits,
+                data=spec.AttestationData(
+                    slot=slot, index=index,
+                    beacon_block_root=spec.get_block_root_at_slot(state, spec.Slot(slot)),
+                    source=source,
+                    target=spec.Checkpoint(epoch=spec.Epoch(epoch), root=target_root),
+                ),
+                inclusion_delay=1,
+                proposer_index=spec.get_beacon_proposer_index(state),
+            ))
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_previous_ok_support_justifies(spec, state):
+    """>2/3 previous-target support: bit 1 set, previous epoch justified."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    prev = int(spec.get_previous_epoch(state))
+    _set_target_support(spec, state, prev, 0.9)
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert int(state.current_justified_checkpoint.epoch) == prev
+    assert bool(state.justification_bits[1])
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_previous_poor_support_no_justification(spec, state):
+    """<=2/3 support leaves the justified checkpoint untouched."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    pre_justified = state.current_justified_checkpoint.copy()
+    _set_target_support(spec, state, int(spec.get_previous_epoch(state)), 0.5)
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert state.current_justified_checkpoint == pre_justified
+    assert not bool(state.justification_bits[1])
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_current_ok_support_justifies_current(spec, state):
+    """>2/3 CURRENT-target support justifies the current epoch (bit 0)."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    cur = int(spec.get_current_epoch(state))
+    # the current-target sweep needs the state at the epoch's final slot
+    # BEFORE the credit is laid down, so attestation block roots resolve
+    from ..testlib.epoch_processing import run_epoch_processing_to
+
+    run_epoch_processing_to(spec, state, "process_justification_and_finalization")
+    _set_target_support(spec, state, cur, 0.9)
+    yield "sub_transition", "meta", "justification_and_finalization"
+    yield "pre", state.copy()
+    spec.process_justification_and_finalization(state)
+    yield "post", state.copy()
+    assert int(state.current_justified_checkpoint.epoch) == cur
+    assert bool(state.justification_bits[0])
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_rule_4_finalizes_previous_justified(spec, state):
+    """bits[0] & bits[1] with current_justified one epoch back finalizes it
+    (the 1-distance rule)."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    cur = int(spec.get_current_epoch(state))
+    from ..testlib.epoch_processing import run_epoch_processing_to
+
+    run_epoch_processing_to(spec, state, "process_justification_and_finalization")
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(cur - 1), root=spec.get_block_root(state, spec.Epoch(cur - 1)))
+    state.justification_bits[0] = True  # shifts into bits[1]
+    _set_target_support(spec, state, cur, 0.9)  # sets bits[0]
+    yield "sub_transition", "meta", "justification_and_finalization"
+    yield "pre", state.copy()
+    spec.process_justification_and_finalization(state)
+    yield "post", state.copy()
+    assert int(state.finalized_checkpoint.epoch) == cur - 1
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_rule_2_finalizes_two_back(spec, state):
+    """bits[1] & bits[2] with previous_justified two epochs back finalizes
+    it (the 2-distance rule over the previous-epoch justification)."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    cur = int(spec.get_current_epoch(state))
+    from ..testlib.epoch_processing import run_epoch_processing_to
+
+    run_epoch_processing_to(spec, state, "process_justification_and_finalization")
+    # rule 2 reads the OLD previous-justified checkpoint (captured before
+    # the rotation at the top of weigh_justification_and_finalization)
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(cur - 2), root=spec.get_block_root(state, spec.Epoch(cur - 2)))
+    state.justification_bits[1] = True  # shifts into bits[2]
+    _set_target_support(spec, state, int(spec.get_previous_epoch(state)), 0.9)  # bits[1]
+    yield "sub_transition", "meta", "justification_and_finalization"
+    yield "pre", state.copy()
+    spec.process_justification_and_finalization(state)
+    yield "post", state.copy()
+    assert int(state.finalized_checkpoint.epoch) == cur - 2
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_jf_ok_support_messed_target_no_justification(spec, state):
+    """Full support on a WRONG target root is not matching-target weight."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    pre_justified = state.current_justified_checkpoint.copy()
+    _set_target_support(spec, state, int(spec.get_previous_epoch(state)), 0.9,
+                        wrong_root=True)
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert state.current_justified_checkpoint == pre_justified
+
+
+@with_all_phases
+@spec_state_test
+def test_jf_balance_threshold_with_exited_validators(spec, state):
+    """Exited-but-not-withdrawable validators drop OUT of the active target
+    denominator: support that counts only the remaining active stake can
+    still justify."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    cur = int(spec.get_current_epoch(state))
+    n = len(state.validators)
+    # exit a third of the registry as of the previous epoch
+    for i in range(n // 3):
+        state.validators[i].exit_epoch = spec.Epoch(cur - 1)
+    prev = int(spec.get_previous_epoch(state))
+    _set_target_support(spec, state, prev, 1.0)  # all remaining active
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+    assert int(state.current_justified_checkpoint.epoch) == prev
+
+
+# --- inactivity-updates matrix ----------------------------------------------
+# Reference parity: test/altair/epoch_processing/
+# test_process_inactivity_updates.py — (scores zero/random) x
+# (participation empty/random/full) x (leaking or not), plus slashed and
+# exited overlays. Post-conditions are asserted against a direct
+# reimplementation of the spec rule on the captured pre-state.
+
+
+def _run_inactivity_scenario(spec, state, *, scores, participation, leaking,
+                             slash_some=False, exit_some=False, seed=0):
+    from random import Random
+
+    from ..testlib.random_scenarios import transition_to_leaking
+
+    rng = Random(seed)
+    if leaking:
+        transition_to_leaking(spec, state)
+    else:
+        next_epoch(spec, state)
+        next_epoch(spec, state)
+    n = len(state.validators)
+    prev = spec.get_previous_epoch(state)
+    target_flag = spec.ParticipationFlags(0)
+    target_flag = spec.add_flag(target_flag, spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(n):
+        state.inactivity_scores[i] = spec.uint64(
+            0 if scores == "zero" else rng.randrange(0, 100))
+        if participation == "empty":
+            flags = spec.ParticipationFlags(0)
+        elif participation == "full":
+            flags = target_flag
+        else:
+            flags = target_flag if rng.random() < 0.5 else spec.ParticipationFlags(0)
+        state.previous_epoch_participation[i] = flags
+    if slash_some:
+        for i in range(0, n, 5):
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = spec.Epoch(int(prev) + 40)
+    if exit_some:
+        for i in range(0, n, 7):
+            state.validators[i].exit_epoch = spec.Epoch(max(1, int(prev) - 1))
+
+    # expected-score model, straight from the spec rule
+    pre_scores = [int(s) for s in state.inactivity_scores]
+    expected = []
+    in_leak = bool(spec.is_in_inactivity_leak(state))
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    eligible = set(int(i) for i in spec.get_eligible_validator_indices(state))
+    participating = set(
+        int(i) for i in spec.get_unslashed_participating_indices(
+            state, spec.TIMELY_TARGET_FLAG_INDEX, prev))
+    for i in range(n):
+        s = pre_scores[i]
+        if i in eligible:
+            if i in participating:
+                s -= min(1, s)
+            else:
+                s += bias
+            if not in_leak:
+                s -= min(recovery, s)
+        expected.append(s)
+
+    yield from run_epoch_processing_with(spec, state, "process_inactivity_updates")
+    got = [int(s) for s in state.inactivity_scores]
+    assert got == expected
+
+
+def _inactivity_case(name, **kw):
+    @with_phases([ALTAIR, BELLATRIX])
+    @spec_state_test
+    def body(spec, state, _kw=kw):
+        yield from _run_inactivity_scenario(spec, state, **_kw)
+
+    body.__name__ = name
+    return body
+
+
+test_inactivity_zero_scores_empty_participation = _inactivity_case(
+    "test_inactivity_zero_scores_empty_participation",
+    scores="zero", participation="empty", leaking=False)
+test_inactivity_zero_scores_empty_participation_leaking = _inactivity_case(
+    "test_inactivity_zero_scores_empty_participation_leaking",
+    scores="zero", participation="empty", leaking=True)
+test_inactivity_zero_scores_random_participation = _inactivity_case(
+    "test_inactivity_zero_scores_random_participation",
+    scores="zero", participation="random", leaking=False, seed=3)
+test_inactivity_zero_scores_random_participation_leaking = _inactivity_case(
+    "test_inactivity_zero_scores_random_participation_leaking",
+    scores="zero", participation="random", leaking=True, seed=4)
+test_inactivity_zero_scores_full_participation = _inactivity_case(
+    "test_inactivity_zero_scores_full_participation",
+    scores="zero", participation="full", leaking=False)
+test_inactivity_zero_scores_full_participation_leaking = _inactivity_case(
+    "test_inactivity_zero_scores_full_participation_leaking",
+    scores="zero", participation="full", leaking=True)
+test_inactivity_random_scores_empty_participation = _inactivity_case(
+    "test_inactivity_random_scores_empty_participation",
+    scores="random", participation="empty", leaking=False, seed=5)
+test_inactivity_random_scores_empty_participation_leaking = _inactivity_case(
+    "test_inactivity_random_scores_empty_participation_leaking",
+    scores="random", participation="empty", leaking=True, seed=6)
+test_inactivity_random_scores_random_participation = _inactivity_case(
+    "test_inactivity_random_scores_random_participation",
+    scores="random", participation="random", leaking=False, seed=7)
+test_inactivity_random_scores_random_participation_leaking = _inactivity_case(
+    "test_inactivity_random_scores_random_participation_leaking",
+    scores="random", participation="random", leaking=True, seed=8)
+test_inactivity_random_scores_full_participation = _inactivity_case(
+    "test_inactivity_random_scores_full_participation",
+    scores="random", participation="full", leaking=False, seed=9)
+test_inactivity_random_scores_full_participation_leaking = _inactivity_case(
+    "test_inactivity_random_scores_full_participation_leaking",
+    scores="random", participation="full", leaking=True, seed=10)
+test_inactivity_some_slashed_full_participation = _inactivity_case(
+    "test_inactivity_some_slashed_full_participation",
+    scores="random", participation="full", leaking=False, slash_some=True, seed=11)
+test_inactivity_some_slashed_random_leaking = _inactivity_case(
+    "test_inactivity_some_slashed_random_leaking",
+    scores="random", participation="random", leaking=True, slash_some=True, seed=12)
+test_inactivity_some_exited_random_leaking = _inactivity_case(
+    "test_inactivity_some_exited_random_leaking",
+    scores="random", participation="random", leaking=True, exit_some=True, seed=13)
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_no_activation_without_finality(spec, state):
+    """Eligibility AFTER the finalized epoch does not dequeue."""
+    for _ in range(3):
+        next_epoch(spec, state)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(0), root=state.finalized_checkpoint.root)
+    v = state.validators[0]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = spec.Epoch(2)  # > finalized epoch 0
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    assert state.validators[0].activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_queue_ordered_by_eligibility(spec, state):
+    """More eligible validators than churn: the queue dequeues in
+    (eligibility epoch, index) order up to the churn limit."""
+    churn_probe = int(spec.get_validator_churn_limit(state))
+    n_eligible = churn_probe + 3
+    # enough epochs that the finalized checkpoint covers EVERY eligibility
+    # epoch below (eligibility > finalized would silently stay queued)
+    for _ in range(n_eligible + 2):
+        next_epoch(spec, state)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.get_current_epoch(state) - 1, root=state.finalized_checkpoint.root)
+    churn = int(spec.get_validator_churn_limit(state))
+    # later indices get EARLIER eligibility epochs: ordering must win
+    for k in range(n_eligible):
+        v = state.validators[k]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = spec.Epoch(n_eligible - k)
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    activated = [k for k in range(n_eligible)
+                 if state.validators[k].activation_epoch != spec.FAR_FUTURE_EPOCH]
+    # the churn-many with the smallest eligibility epochs = highest indices
+    assert len(activated) == churn
+    # smallest eligibility epochs = highest indices (order-insensitive set)
+    assert set(activated) == set(range(n_eligible - churn, n_eligible))
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_updates_mass_ejection_spreads_exits(spec, state):
+    """Ejecting more validators than the churn limit spreads exit epochs
+    over multiple future epochs (the exit-queue backpressure)."""
+    next_epoch(spec, state)
+    churn = int(spec.get_validator_churn_limit(state))
+    n_eject = 2 * churn + 1
+    for k in range(n_eject):
+        state.validators[k].effective_balance = spec.config.EJECTION_BALANCE
+    yield from run_epoch_processing_with(spec, state, "process_registry_updates")
+    exit_epochs = sorted(int(state.validators[k].exit_epoch) for k in range(n_eject))
+    assert len(set(exit_epochs)) >= 2  # spread, not a single epoch
+    from collections import Counter
+
+    assert all(c <= churn for c in Counter(exit_epochs).values())
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_data_reset_clears_votes_at_period(spec, state):
+    """Votes accumulated during a voting period vanish at its boundary."""
+    period_epochs = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD)
+    while (int(spec.get_current_epoch(state)) + 1) % period_epochs != 0:
+        next_epoch(spec, state)
+    state.eth1_data_votes.append(spec.Eth1Data(deposit_count=7))
+    state.eth1_data_votes.append(spec.Eth1Data(deposit_count=8))
+    yield from run_epoch_processing_with(spec, state, "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_historical_roots_append_matches_batch_root(spec, state):
+    """The appended accumulator entry IS hash_tree_root(HistoricalBatch)."""
+    period_epochs = int(spec.SLOTS_PER_HISTORICAL_ROOT) // int(spec.SLOTS_PER_EPOCH)
+    while (int(spec.get_current_epoch(state)) + 1) % period_epochs != 0:
+        next_epoch(spec, state)
+    pre_len = len(state.historical_roots)
+    yield from run_epoch_processing_with(spec, state, "process_historical_roots_update")
+    assert len(state.historical_roots) == pre_len + 1
+    batch = spec.HistoricalBatch(
+        block_roots=state.block_roots, state_roots=state.state_roots)
+    assert bytes(state.historical_roots[pre_len]) == bytes(spec.hash_tree_root(batch))
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_penalty_proportional_to_effective_balance(spec, state):
+    """Correlated-slashing penalties scale with the victim's effective
+    balance, increment-quantized, exactly per the spec formula. The lighter
+    validator sits ABOVE the ejection balance: at it, process_registry_updates
+    (which runs earlier) would eject and re-schedule withdrawability,
+    silently skipping the penalty."""
+    from ..testlib.epoch_processing import run_epoch_processing_to
+
+    epoch = int(spec.get_current_epoch(state))
+    mid = epoch + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    a, b = 0, 1
+    state.validators[a].slashed = True
+    state.validators[b].slashed = True
+    state.validators[a].withdrawable_epoch = spec.Epoch(mid)
+    state.validators[b].withdrawable_epoch = spec.Epoch(mid)
+    state.validators[b].effective_balance = spec.Gwei(
+        int(spec.config.EJECTION_BALANCE) + 8 * inc)  # 24 ETH on minimal
+    total = sum(int(v.effective_balance) for v in state.validators)
+    state.slashings[epoch % int(spec.EPOCHS_PER_SLASHINGS_VECTOR)] = spec.Gwei(total // 3)
+    run_epoch_processing_to(spec, state, "process_slashings")
+    if spec.fork == "phase0":
+        mult = int(spec.PROPORTIONAL_SLASHING_MULTIPLIER)
+    else:
+        from ..forks import is_post
+
+        mult = int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+                   if is_post(spec.fork, "bellatrix")
+                   else spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR)
+    total_now = int(spec.get_total_balance(
+        state, set(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))))
+    adjusted = min(int(sum(int(x) for x in state.slashings)) * mult, total_now)
+    pre_a, pre_b = int(state.balances[a]), int(state.balances[b])
+    expected = {
+        i: int(state.validators[i].effective_balance) // inc * adjusted // total_now * inc
+        for i in (a, b)
+    }
+    yield "sub_transition", "meta", "slashings"
+    yield "pre", state.copy()
+    spec.process_slashings(state)
+    yield "post", state.copy()
+    assert pre_a - int(state.balances[a]) == expected[a] > 0
+    assert pre_b - int(state.balances[b]) == expected[b] > 0
+    assert expected[a] > expected[b]
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_participation_record_updates_rotation(spec, state):
+    """phase0's pending-attestation rotation: current -> previous, current
+    cleared (the pre-altair analog of the flag rotation)."""
+    from ..testlib.attestations import add_attestations_for_epoch
+    from ..testlib.state import next_slots
+
+    next_slots(spec, state, int(spec.SLOTS_PER_EPOCH) // 2)
+    add_attestations_for_epoch(spec, state, spec.get_current_epoch(state))
+    n_current = len(state.current_epoch_attestations)
+    assert n_current > 0
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_record_updates")
+    assert len(state.previous_epoch_attestations) == n_current
+    assert len(state.current_epoch_attestations) == 0
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_sync_committee_no_rotation_mid_period(spec, state):
+    pre_cur = state.current_sync_committee.hash_tree_root()
+    pre_next = state.next_sync_committee.hash_tree_root()
+    next_epoch(spec, state)  # mid-period (EPOCHS_PER_SYNC_COMMITTEE_PERIOD > 2)
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+    assert state.current_sync_committee.hash_tree_root() == pre_cur
+    assert state.next_sync_committee.hash_tree_root() == pre_next

@@ -18,6 +18,7 @@ from ..testlib.fork_choice import (
     add_checks_step,
     finalize_steps,
     initialize_steps,
+    on_tick_step,
     tick_to_slot_step,
 )
 from ..testlib.state import next_slots
@@ -529,4 +530,132 @@ def test_ex_ante_sandwich_with_honest_attestation(spec, state):
     tick_to_slot_step(spec, store, steps, 5)
     head = add_checks_step(spec, store, steps)
     assert head == signed_c.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_checkpoints_follow_chain(spec, state):
+    """Store checkpoints after four attested epochs equal the head state's
+    (reference test_on_block_checkpoints). Four epochs reach REAL finality:
+    at genesis the store's checkpoints carry the anchor-block root while
+    the state's carry Root() — comparable only once both advance to
+    chain-derived checkpoints."""
+    store, parts, steps = initialize_steps(spec, state)
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        for signed in blocks:
+            tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+            add_block_step(spec, store, parts, steps, signed)
+    # tick into the next epoch: on_tick promotes best_justified to
+    # justified at the boundary (the v1.1.8 SAFE_SLOTS machinery), after
+    # which store and head-state checkpoints must agree
+    tick_to_slot_step(spec, store, steps, int(state.slot) + int(spec.SLOTS_PER_EPOCH))
+    head = add_checks_step(spec, store, steps)
+    head_state = store.block_states[head]
+    assert int(store.finalized_checkpoint.epoch) > 0
+    assert store.justified_checkpoint == head_state.current_justified_checkpoint
+    assert store.finalized_checkpoint == head_state.finalized_checkpoint
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_finalized_skip_slots(spec, state):
+    """A block built on the finalized checkpoint's chain after skipped
+    slots imports fine as long as it descends from the finalized block
+    (reference test_on_block_finalized_skip_slots)."""
+    store, parts, steps = initialize_steps(spec, state)
+    for _ in range(4):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        for signed in blocks:
+            tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+            add_block_step(spec, store, parts, steps, signed)
+    assert int(store.finalized_checkpoint.epoch) > 0
+    # skip several slots, then extend the canonical chain
+    next_slots(spec, state, 3)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+    add_block_step(spec, store, parts, steps, signed)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_untimely_same_slot_block(spec, state):
+    """A block arriving AFTER the attestation deadline of its own slot gets
+    no boost (reference test_proposer_boost_root_same_slot_untimely_block)."""
+    store, parts, steps = initialize_steps(spec, state)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick into slot 1 but past the SECONDS_PER_SLOT // INTERVALS_PER_SLOT
+    # attestation deadline
+    seconds_per_slot = int(spec.config.SECONDS_PER_SLOT)
+    late = int(store.genesis_time) + seconds_per_slot + (
+        seconds_per_slot // int(spec.INTERVALS_PER_SLOT)) + 1
+    on_tick_step(spec, store, steps, late)
+    add_block_step(spec, store, parts, steps, signed)
+    assert store.proposer_boost_root == spec.Root()
+    head = add_checks_step(spec, store, steps)
+    assert head == signed.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_justification_within_epoch_boundary(spec, state):
+    """Justification learned via on_block updates the store immediately
+    when the new checkpoint is newer (reference
+    test_new_justified_is_later_than_store_justified, the core branch)."""
+    store, parts, steps = initialize_steps(spec, state)
+    pre_justified = store.justified_checkpoint.copy()
+    for _ in range(3):
+        _, blocks, state = next_epoch_with_attestations(spec, state, True, True)
+        for signed in blocks:
+            tick_to_slot_step(spec, store, steps, int(signed.message.slot))
+            add_block_step(spec, store, parts, steps, signed)
+    assert int(store.justified_checkpoint.epoch) > int(pre_justified.epoch)
+    head = add_checks_step(spec, store, steps)
+    assert store.blocks[head].slot == state.slot
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_previous_epoch_accepted(spec, state):
+    """An attestation from the previous epoch (within range) counts for
+    LMD votes (reference on_attestation previous-epoch path)."""
+    store, parts, steps = initialize_steps(spec, state)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed)
+    att_state = state.copy()
+    next_slots(spec, att_state, 1)
+    att = get_valid_attestation(spec, att_state, slot=spec.Slot(1), signed=True)
+    # tick into the NEXT epoch: the attestation is now previous-epoch
+    tick_to_slot_step(spec, store, steps, int(spec.SLOTS_PER_EPOCH) + 1)
+    add_attestation_step(spec, store, parts, steps, att)
+    head = add_checks_step(spec, store, steps)
+    assert head == signed.message.hash_tree_root()
+    yield from finalize_steps(parts, steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_two_epochs_old_rejected(spec, state):
+    """An attestation two epochs old fails on_attestation's recency check."""
+    store, parts, steps = initialize_steps(spec, state)
+    block = build_empty_block(spec, state, spec.Slot(1))
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_to_slot_step(spec, store, steps, 1)
+    add_block_step(spec, store, parts, steps, signed)
+    att_state = state.copy()
+    next_slots(spec, att_state, 1)
+    att = get_valid_attestation(spec, att_state, slot=spec.Slot(1), signed=True)
+    tick_to_slot_step(spec, store, steps, 2 * int(spec.SLOTS_PER_EPOCH) + 1)
+    add_attestation_step(spec, store, parts, steps, att, valid=False)
     yield from finalize_steps(parts, steps)

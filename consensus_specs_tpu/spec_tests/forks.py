@@ -53,3 +53,115 @@ def test_fork_next_epoch_to_bellatrix(spec, state=None, phases=None):
     yield from _upgrade_case(
         spec, phases[BELLATRIX], "upgrade_to_bellatrix", "bellatrix", advance_epochs=1
     )
+
+
+def _randomized_upgrade_case(spec, post_spec, upgrade_fn_name, fork_name,
+                             seed, balances="default", epochs=0):
+    """Randomized pre-state upgrade (reference test_altair_fork_random_*):
+    scrambled balances/flags/slashings must survive the conversion with
+    every registry field intact."""
+    from random import Random
+
+    state = create_valid_beacon_state(spec)
+    rng = Random(seed)
+    n = len(state.validators)
+    for i in range(n):
+        if balances == "low":
+            state.balances[i] = spec.Gwei(int(spec.config.EJECTION_BALANCE))
+        elif balances == "misc":
+            state.balances[i] = spec.Gwei(
+                rng.choice([0, int(spec.config.EJECTION_BALANCE),
+                            int(spec.MAX_EFFECTIVE_BALANCE),
+                            rng.randrange(int(spec.MAX_EFFECTIVE_BALANCE))]))
+        else:
+            state.balances[i] = spec.Gwei(rng.randrange(0, 40_000_000_000))
+        if rng.random() < 0.15:
+            state.validators[i].slashed = True
+            state.validators[i].withdrawable_epoch = spec.Epoch(rng.randrange(1, 60))
+        if rng.random() < 0.1:
+            state.validators[i].exit_epoch = spec.Epoch(rng.randrange(1, 30))
+    spec.process_effective_balance_updates(state)
+    for _ in range(epochs):
+        next_epoch(spec, state)
+    yield "pre", state.copy()
+    yield "meta", "meta", {"fork": fork_name}
+    post = getattr(post_spec, upgrade_fn_name)(state)
+    assert [int(b) for b in post.balances] == [int(b) for b in state.balances]
+    for i in (0, n // 2, n - 1):
+        a, b = state.validators[i], post.validators[i]
+        assert bytes(a.pubkey) == bytes(b.pubkey)
+        assert int(a.effective_balance) == int(b.effective_balance)
+        assert bool(a.slashed) == bool(b.slashed)
+        assert int(a.exit_epoch) == int(b.exit_epoch)
+    if fork_name == "altair":
+        # fresh participation/inactivity columns, zeroed
+        assert all(int(f) == 0 for f in post.previous_epoch_participation)
+        assert all(int(s) == 0 for s in post.inactivity_scores)
+        # non-trivial sync committees installed
+        assert len(post.current_sync_committee.pubkeys) == int(
+            post_spec.SYNC_COMMITTEE_SIZE)
+    yield "post", post
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_to_altair_random_0(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[ALTAIR], "upgrade_to_altair", "altair", seed=100)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_to_altair_random_1(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[ALTAIR], "upgrade_to_altair", "altair", seed=101, epochs=1)
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_to_altair_random_low_balances(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[ALTAIR], "upgrade_to_altair", "altair", seed=102, balances="low")
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_to_altair_random_misc_balances(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[ALTAIR], "upgrade_to_altair", "altair", seed=103, balances="misc")
+
+
+@with_phases([PHASE0], other_phases=[ALTAIR])
+@spec_test
+def test_fork_to_altair_many_epochs(spec, state=None, phases=None):
+    yield from _upgrade_case(
+        spec, phases[ALTAIR], "upgrade_to_altair", "altair", advance_epochs=3)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_fork_to_bellatrix_random_0(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[BELLATRIX], "upgrade_to_bellatrix", "bellatrix", seed=104)
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_fork_to_bellatrix_random_misc_balances(spec, state=None, phases=None):
+    yield from _randomized_upgrade_case(
+        spec, phases[BELLATRIX], "upgrade_to_bellatrix", "bellatrix",
+        seed=105, balances="misc")
+
+
+@with_phases([ALTAIR], other_phases=[BELLATRIX])
+@spec_test
+def test_fork_to_bellatrix_empty_payload_header(spec, state=None, phases=None):
+    """The merge upgrade installs an EMPTY execution payload header — the
+    chain is pre-merge at the fork."""
+    post_spec = phases[BELLATRIX]
+    state = create_valid_beacon_state(spec)
+    yield "pre", state.copy()
+    yield "meta", "meta", {"fork": "bellatrix"}
+    post = post_spec.upgrade_to_bellatrix(state)
+    assert post.latest_execution_payload_header == post_spec.ExecutionPayloadHeader()
+    yield "post", post

@@ -10,6 +10,7 @@ test_slots.py (empty block, skipped slots, proposer slashings path).
 from ..testlib.block import (
     build_empty_block,
     build_empty_block_for_next_slot,
+    sign_block,
     state_transition_and_sign_block,
 )
 from ..testlib.context import spec_state_test, with_all_phases
@@ -101,6 +102,15 @@ def _finish_block(spec, state, block):
     """Compute state_root + sign for a block built against `state` (which is
     then advanced through it)."""
     return state_transition_and_sign_block(spec, state, block)
+
+
+def _sign_invalid_block(spec, state, block):
+    """Sign a block whose BODY is deliberately invalid: no transition is
+    possible, so the state root stays zeroed — process_block rejects the
+    bad operation before state_transition ever compares roots."""
+    tmp = state.copy()
+    spec.process_slots(tmp, block.slot)
+    return sign_block(spec, tmp, block)
 
 
 @with_all_phases
@@ -424,3 +434,170 @@ def test_full_epoch_with_attestations_finalizes(spec, state):
         yield f"blocks_{i}", sb
     yield "post", state.copy()
     assert int(state.current_justified_checkpoint.epoch) > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_self_slashing_block(spec, state):
+    """A proposer may include evidence slashing ITSELF: the header check
+    runs before operations, so the block is valid and the proposer ends
+    the block slashed."""
+    from ..testlib.slashings import build_proposer_slashing
+
+    # find the next slot's proposer and slash them in their own block
+    probe = state.copy()
+    spec.process_slots(probe, probe.slot + 1)
+    proposer = int(spec.get_beacon_proposer_index(probe))
+    slashing = build_proposer_slashing(spec, state, proposer_index=proposer, signed=True)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    assert int(block.proposer_index) == proposer
+    block.body.proposer_slashings.append(slashing)
+    signed = _finish_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert state.validators[proposer].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_double_same_proposer_slashings_same_block(spec, state):
+    """The SAME slashing twice in one block: the second application finds
+    the proposer already slashed -> whole block invalid."""
+    from ..testlib.slashings import build_proposer_slashing
+
+    slashing = build_proposer_slashing(spec, state, signed=True)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    block.body.proposer_slashings.append(slashing)
+    yield from _expect_invalid_block(spec, state, _sign_invalid_block(spec, state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+
+    probe = state.copy()
+    spec.process_slots(probe, probe.slot + 1)
+    next_proposer = int(spec.get_beacon_proposer_index(probe))
+    targets = [i for i in range(4) if i != next_proposer][:2]
+    slashings = [
+        build_proposer_slashing(spec, state, proposer_index=i, signed=True)
+        for i in targets
+    ]
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    for s in slashings:
+        block.body.proposer_slashings.append(s)
+    signed = _finish_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert all(state.validators[i].slashed for i in targets)
+
+
+@with_all_phases
+@spec_state_test
+def test_double_validator_exit_same_block(spec, state):
+    """The same voluntary exit twice in one block: second one hits an
+    already-exiting validator -> invalid block."""
+    from ..testlib.voluntary_exits import (
+        age_state_past_shard_committee_period,
+        build_voluntary_exit,
+    )
+
+    age_state_past_shard_committee_period(spec, state)
+    exit_op = build_voluntary_exit(spec, state, 3)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits.append(exit_op)
+    block.body.voluntary_exits.append(exit_op)
+    yield from _expect_invalid_block(spec, state, _sign_invalid_block(spec, state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_multiple_different_validator_exits_same_block(spec, state):
+    from ..testlib.voluntary_exits import (
+        age_state_past_shard_committee_period,
+        build_voluntary_exit,
+    )
+
+    age_state_past_shard_committee_period(spec, state)
+    indices = (3, 5, 7)
+    exits = [build_voluntary_exit(spec, state, i) for i in indices]
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    for e in exits:
+        block.body.voluntary_exits.append(e)
+    signed = _finish_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert all(state.validators[i].exit_epoch != spec.FAR_FUTURE_EPOCH for i in indices)
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_same_index_rejected(spec, state):
+    """Slashing and a voluntary exit for the SAME validator in one block:
+    the exit finds the validator slashed-and-exiting -> invalid."""
+    from ..testlib.slashings import build_proposer_slashing
+    from ..testlib.voluntary_exits import (
+        age_state_past_shard_committee_period,
+        build_voluntary_exit,
+    )
+
+    age_state_past_shard_committee_period(spec, state)
+    idx = 3
+    slashing = build_proposer_slashing(spec, state, proposer_index=idx, signed=True)
+    exit_op = build_voluntary_exit(spec, state, idx)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    block.body.voluntary_exits.append(exit_op)
+    yield from _expect_invalid_block(spec, state, _sign_invalid_block(spec, state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_slash_and_exit_diff_index_same_block(spec, state):
+    from ..testlib.slashings import build_proposer_slashing
+    from ..testlib.voluntary_exits import (
+        age_state_past_shard_committee_period,
+        build_voluntary_exit,
+    )
+
+    age_state_past_shard_committee_period(spec, state)
+    probe = state.copy()
+    spec.process_slots(probe, probe.slot + 1)
+    next_proposer = int(spec.get_beacon_proposer_index(probe))
+    slash_idx = next(i for i in range(8) if i != next_proposer)
+    exit_idx = next(i for i in range(8) if i not in (slash_idx, next_proposer))
+    slashing = build_proposer_slashing(spec, state, proposer_index=slash_idx, signed=True)
+    exit_op = build_voluntary_exit(spec, state, exit_idx)
+    yield "pre", state.copy()
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings.append(slashing)
+    block.body.voluntary_exits.append(exit_op)
+    signed = _finish_block(spec, state, block)
+    yield "blocks", "data", 1
+    yield "blocks_0", signed
+    yield "post", state.copy()
+    assert state.validators[slash_idx].slashed
+    assert state.validators[exit_idx].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_prev_slot_block_rejected(spec, state):
+    """A block whose slot is behind the state's is invalid."""
+    tmp = state.copy()
+    block = build_empty_block_for_next_slot(spec, tmp)
+    signed = state_transition_and_sign_block(spec, tmp, block)
+    # advance the real state PAST the block's slot before applying
+    next_slots(spec, state, 2)
+    yield from _expect_invalid_block(spec, state, signed)

@@ -4,7 +4,7 @@ Reference parity: test/phase0/unittests/ (validator unittests 478 LoC,
 helper/predicate unittests) — the layer below block/epoch processing: no
 vectors, just invariants of the spec's helper functions on live states.
 """
-from ..testlib.context import spec_state_test, with_all_phases
+from ..testlib.context import ALTAIR, BELLATRIX, always_bls, spec_state_test, with_all_phases, with_phases
 from ..testlib.state import next_epoch, next_slots
 
 
@@ -178,3 +178,321 @@ def test_eth1_vote_period_boundary(spec, state):
         int(state.slot) * int(spec.config.SECONDS_PER_SLOT)
     votes_len_bound = int(type(state.eth1_data_votes).LIMIT)
     assert votes_len_bound == period_slots
+
+
+@with_all_phases
+@spec_state_test
+def test_is_slashable_attestation_data_matrix(spec, state):
+    """Double vote (same target, different data) and surround vote are
+    slashable; identical data and plain successive votes are not."""
+    ck = lambda e: spec.Checkpoint(epoch=spec.Epoch(e), root=b"\x00" * 32)
+    mk = lambda src, tgt, slot=0: spec.AttestationData(
+        slot=spec.Slot(slot), index=0, beacon_block_root=b"\x11" * 32,
+        source=ck(src), target=ck(tgt))
+    a = mk(0, 3)
+    double = mk(0, 3, slot=1)  # same target epoch, different data
+    surround = mk(1, 2)        # a surrounds it: 0 < 1 and 2 < 3
+    later = mk(3, 4)
+    assert spec.is_slashable_attestation_data(a, double)
+    assert spec.is_slashable_attestation_data(a, surround)
+    assert not spec.is_slashable_attestation_data(surround, a)  # order matters
+    assert not spec.is_slashable_attestation_data(a, a)         # identical
+    assert not spec.is_slashable_attestation_data(a, later)
+
+
+@with_all_phases
+@spec_state_test
+def test_is_slashable_validator_windows(spec, state):
+    v = state.validators[0].copy()
+    v.slashed = False
+    v.activation_epoch = spec.Epoch(2)
+    v.withdrawable_epoch = spec.Epoch(10)
+    assert not spec.is_slashable_validator(v, spec.Epoch(1))  # not yet active
+    assert spec.is_slashable_validator(v, spec.Epoch(2))
+    assert spec.is_slashable_validator(v, spec.Epoch(9))
+    assert not spec.is_slashable_validator(v, spec.Epoch(10))  # withdrawable
+    v.slashed = True
+    assert not spec.is_slashable_validator(v, spec.Epoch(5))   # already slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_fork_digest_distinguishes_versions(spec, state):
+    root = state.genesis_validators_root
+    d1 = spec.compute_fork_digest(spec.Version(b"\x00" * 4), root)
+    d2 = spec.compute_fork_digest(spec.Version(b"\x01\x00\x00\x00"), root)
+    d3 = spec.compute_fork_digest(spec.Version(b"\x00" * 4), spec.Root(b"\x01" * 32))
+    assert len(bytes(d1)) == 4
+    assert bytes(d1) != bytes(d2) and bytes(d1) != bytes(d3)
+    # deterministic
+    assert bytes(d1) == bytes(spec.compute_fork_digest(spec.Version(b"\x00" * 4), root))
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_domain_binds_fork_and_genesis(spec, state):
+    base = spec.compute_domain(spec.DOMAIN_BEACON_PROPOSER)
+    forked = spec.compute_domain(
+        spec.DOMAIN_BEACON_PROPOSER, spec.Version(b"\x01\x02\x03\x04"))
+    rooted = spec.compute_domain(
+        spec.DOMAIN_BEACON_PROPOSER, None, spec.Root(b"\x42" * 32))
+    assert bytes(base)[:4] == bytes(spec.DOMAIN_BEACON_PROPOSER)
+    assert bytes(base) != bytes(forked)
+    assert bytes(base) != bytes(rooted)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_committee_count_per_slot_bounds(spec, state):
+    epoch = spec.get_current_epoch(state)
+    count = int(spec.get_committee_count_per_slot(state, epoch))
+    assert 1 <= count <= int(spec.MAX_COMMITTEES_PER_SLOT)
+    n_active = len(spec.get_active_validator_indices(state, epoch))
+    assert count <= max(1, n_active // int(spec.SLOTS_PER_EPOCH))
+
+
+@with_all_phases
+@spec_state_test
+def test_churn_limit_floors_at_minimum(spec, state):
+    limit = int(spec.get_validator_churn_limit(state))
+    assert limit >= int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    n_active = len(spec.get_active_validator_indices(state, spec.get_current_epoch(state)))
+    assert limit == max(
+        int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT),
+        n_active // int(spec.config.CHURN_LIMIT_QUOTIENT))
+
+
+@with_all_phases
+@spec_state_test
+def test_get_block_root_wraps_history_vector(spec, state):
+    """get_block_root_at_slot indexes modulo SLOTS_PER_HISTORICAL_ROOT and
+    rejects slots outside the window."""
+    from ..testlib.state import next_slots
+
+    next_slots(spec, state, 3)
+    slot = spec.Slot(int(state.slot) - 1)
+    root = spec.get_block_root_at_slot(state, slot)
+    assert bytes(root) == bytes(
+        state.block_roots[int(slot) % int(spec.SLOTS_PER_HISTORICAL_ROOT)])
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        spec.get_block_root_at_slot(state, state.slot)  # current slot: not yet rooted
+
+
+@with_all_phases
+@spec_state_test
+def test_get_seed_mixes_domain_epoch_and_randao(spec, state):
+    e = spec.get_current_epoch(state)
+    s1 = spec.get_seed(state, e, spec.DOMAIN_BEACON_ATTESTER)
+    s2 = spec.get_seed(state, e, spec.DOMAIN_BEACON_PROPOSER)
+    assert bytes(s1) != bytes(s2)
+    mix_idx = (int(e) + int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
+               - int(spec.MIN_SEED_LOOKAHEAD) - 1) % int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
+    state.randao_mixes[mix_idx] = spec.Bytes32(b"\x37" * 32)
+    assert bytes(spec.get_seed(state, e, spec.DOMAIN_BEACON_ATTESTER)) != bytes(s1)
+
+
+@with_all_phases
+@spec_state_test
+def test_slot_epoch_conversions_roundtrip(spec, state):
+    per = int(spec.SLOTS_PER_EPOCH)
+    for slot in (0, 1, per - 1, per, 7 * per + 3):
+        epoch = spec.compute_epoch_at_slot(spec.Slot(slot))
+        assert int(epoch) == slot // per
+        start = spec.compute_start_slot_at_epoch(epoch)
+        assert int(start) == int(epoch) * per
+        assert int(start) <= slot < int(start) + per
+
+
+@with_all_phases
+@spec_state_test
+def test_increase_decrease_balance_saturates(spec, state):
+    i = spec.ValidatorIndex(0)
+    state.balances[0] = spec.Gwei(10)
+    spec.decrease_balance(state, i, spec.Gwei(100))
+    assert int(state.balances[0]) == 0  # floor at zero, no underflow
+    spec.increase_balance(state, i, spec.Gwei(7))
+    assert int(state.balances[0]) == 7
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_flag_helpers_roundtrip(spec, state):
+    flags = spec.ParticipationFlags(0)
+    for idx in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        assert not spec.has_flag(flags, idx)
+        flags = spec.add_flag(flags, idx)
+        assert spec.has_flag(flags, idx)
+    assert int(flags) == (1 << len(spec.PARTICIPATION_FLAG_WEIGHTS)) - 1
+    # adding an already-set flag is idempotent
+    assert int(spec.add_flag(flags, 0)) == int(flags)
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_base_reward_proportional_to_effective_balance(spec, state):
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.validators[1].effective_balance = spec.Gwei(
+        int(spec.MAX_EFFECTIVE_BALANCE) // 2)
+    r0 = int(spec.get_base_reward(state, spec.ValidatorIndex(0)))
+    r1 = int(spec.get_base_reward(state, spec.ValidatorIndex(1)))
+    assert r0 == 2 * r1
+
+
+@with_all_phases
+@spec_state_test
+def test_get_total_balance_sums_effective_not_actual(spec, state):
+    state.balances[0] = spec.Gwei(1)  # actual balance drained
+    total = int(spec.get_total_balance(state, {spec.ValidatorIndex(0)}))
+    assert total == int(state.validators[0].effective_balance)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_signing_root_domain_separation(spec, state):
+    msg = spec.Checkpoint(epoch=1, root=b"\x22" * 32)
+    d1 = spec.compute_domain(spec.DOMAIN_BEACON_ATTESTER)
+    d2 = spec.compute_domain(spec.DOMAIN_RANDAO)
+    assert bytes(spec.compute_signing_root(msg, d1)) != bytes(
+        spec.compute_signing_root(msg, d2))
+
+
+@with_all_phases
+@spec_state_test
+def test_validator_activation_epoch_gates_activity(spec, state):
+    v = state.validators[0].copy()
+    v.activation_epoch = spec.Epoch(5)
+    v.exit_epoch = spec.Epoch(9)
+    active_epochs = [e for e in range(12) if spec.is_active_validator(v, spec.Epoch(e))]
+    assert active_epochs == [5, 6, 7, 8]
+
+
+@with_all_phases
+@spec_state_test
+def test_merkle_branch_for_finalized_checkpoint_verifies(spec, state):
+    """build_proof + is_valid_merkle_branch over the state tree — the
+    light-client proof shape (sync-protocol gindex machinery)."""
+    import consensus_specs_tpu.ssz as ssz
+
+    gindex = ssz.get_generalized_index(type(state), "finalized_checkpoint")
+    proof = ssz.build_proof(state, gindex)
+    leaf = spec.hash_tree_root(state.finalized_checkpoint)
+    depth = ssz.floorlog2(gindex) if hasattr(ssz, "floorlog2") else gindex.bit_length() - 1
+    index = gindex - (1 << depth)
+    assert spec.is_valid_merkle_branch_impl(
+        leaf, proof, depth, index, spec.hash_tree_root(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_merkle_branch_rejects_wrong_leaf(spec, state):
+    import consensus_specs_tpu.ssz as ssz
+
+    gindex = ssz.get_generalized_index(type(state), "finalized_checkpoint")
+    proof = ssz.build_proof(state, gindex)
+    depth = gindex.bit_length() - 1
+    index = gindex - (1 << depth)
+    assert not spec.is_valid_merkle_branch_impl(
+        spec.Root(b"\x13" * 32), proof, depth, index, spec.hash_tree_root(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_data_root_binds_both_inputs(spec, state):
+    a = spec.compute_fork_data_root(spec.Version(b"\x00" * 4), spec.Root(b"\x00" * 32))
+    b = spec.compute_fork_data_root(spec.Version(b"\x01\x00\x00\x00"), spec.Root(b"\x00" * 32))
+    c = spec.compute_fork_data_root(spec.Version(b"\x00" * 4), spec.Root(b"\x01" * 32))
+    assert len({bytes(a), bytes(b), bytes(c)}) == 3
+    # the fork digest is its 4-byte prefix
+    d = spec.compute_fork_digest(spec.Version(b"\x00" * 4), spec.Root(b"\x00" * 32))
+    assert bytes(a)[:4] == bytes(d)
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_time_at_slot_linear(spec, state):
+    t0 = int(spec.compute_time_at_slot(state, spec.Slot(0)))
+    t5 = int(spec.compute_time_at_slot(state, spec.Slot(5)))
+    assert t0 == int(state.genesis_time)
+    assert t5 == t0 + 5 * int(spec.config.SECONDS_PER_SLOT)
+
+
+@with_all_phases
+@spec_state_test
+def test_weak_subjectivity_period_floors_at_withdrawability_delay(spec, state):
+    """The ws period never undercuts the withdrawability delay (the
+    formula's additive floor). The churn/balance-dependent term is
+    covered in depth by tests/test_weak_subjectivity.py."""
+    base = int(spec.compute_weak_subjectivity_period(state))
+    assert base >= int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+@with_all_phases
+@spec_state_test
+def test_uint_to_bytes_little_endian(spec, state):
+    assert bytes(spec.uint_to_bytes(spec.uint64(1))) == b"\x01" + b"\x00" * 7
+    assert bytes(spec.uint_to_bytes(spec.uint64(0x0102030405060708))) == bytes(
+        reversed(bytes.fromhex("0102030405060708")))
+
+
+@with_all_phases
+@spec_state_test
+def test_bytes_to_uint64_roundtrip(spec, state):
+    for x in (0, 1, 255, 2**32, 2**64 - 1):
+        assert int(spec.bytes_to_uint64(bytes(spec.uint_to_bytes(spec.uint64(x))))) == x
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@always_bls
+@spec_state_test
+def test_eth_fast_aggregate_verify_infinity_empty_set(spec, state):
+    """The altair bls.md edge: empty pubkeys + G2 infinity accepts; empty
+    pubkeys + any other signature rejects (the rejection leg needs a live
+    backend — the kill-switch stubs every verify to True)."""
+    root = spec.Root(b"\x00" * 32)
+    assert spec.eth_fast_aggregate_verify([], root, spec.G2_POINT_AT_INFINITY)
+    assert not spec.eth_fast_aggregate_verify([], root, b"\xc1" + b"\x00" * 95)
+
+
+@with_phases([ALTAIR, BELLATRIX])
+@spec_state_test
+def test_sync_committee_aggregate_matches_members(spec, state):
+    """The stored aggregate_pubkey is eth_aggregate_pubkeys(members)."""
+    expected = spec.eth_aggregate_pubkeys(list(state.current_sync_committee.pubkeys))
+    assert bytes(state.current_sync_committee.aggregate_pubkey) == bytes(expected)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_merge_transition_predicates_pre_merge(spec, state):
+    """Fresh bellatrix state with an empty payload header: transition not
+    complete; a block with an empty payload is not execution-enabled, one
+    with a payload is."""
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+    body_empty = spec.BeaconBlockBody()
+    assert not spec.is_execution_enabled(state, body_empty)
+    body_full = spec.BeaconBlockBody()
+    body_full.execution_payload.block_number = 1
+    assert spec.is_merge_transition_block(state, body_full)
+    assert spec.is_execution_enabled(state, body_full)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_merge_transition_predicates_post_merge(spec, state):
+    state.latest_execution_payload_header.block_number = 7
+    state.latest_execution_payload_header.block_hash = spec.Hash32(b"\x01" * 32)
+    assert spec.is_merge_transition_complete(state)
+    assert spec.is_execution_enabled(state, spec.BeaconBlockBody())
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_compute_timestamp_at_slot_matches_genesis_offset(spec, state):
+    from ..testlib.state import next_slots
+
+    next_slots(spec, state, 3)
+    ts = int(spec.compute_timestamp_at_slot(state, state.slot))
+    assert ts == int(state.genesis_time) + 3 * int(spec.config.SECONDS_PER_SLOT)

@@ -15,7 +15,11 @@ ALL_MODS = {
     "phase0": {
         "initialization": (genesis, "initialize_"),
         "validity": (genesis, "validity_"),
-    }
+    },
+    # altair genesis override: sync committees sampled at initialization
+    "altair": {
+        "initialization": (genesis, "initialize_"),
+    },
 }
 
 if __name__ == "__main__":

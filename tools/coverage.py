@@ -1,0 +1,135 @@
+"""Line-coverage measurement on stdlib sys.monitoring (PEP 669) — no
+third-party coverage package exists in this environment, and the build
+gates on measured coverage the way the reference gates on pytest-cov
+(`/root/reference/Makefile:100` --cov=eth2spec).
+
+Usage:
+    python tools/coverage.py [--min PCT] [--report N] -- <python args...>
+    e.g. python tools/coverage.py --min 60 -- -m pytest tests/ -q -m "not slow"
+
+Mechanics: sys.monitoring LINE events record every executed (file, line)
+for files under consensus_specs_tpu/ (the compiled-markdown spec modules
+exec under synthetic filenames and are skipped — their conformance is
+measured by the vector round-trip, not line counts). Executable lines per
+file come from compiling the source and walking the code objects'
+co_lines(), so docstrings/blank lines/comments are excluded exactly as
+the interpreter sees them. Exit status is non-zero when total coverage
+falls below --min.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "consensus_specs_tpu"
+
+TOOL_ID = sys.monitoring.PROFILER_ID
+_hits: dict[str, set[int]] = defaultdict(set)
+
+
+def _want(path: str) -> bool:
+    return path.startswith(str(PKG)) and path.endswith(".py")
+
+
+def _on_line(code, line):
+    # record the first hit, then DISABLE this exact (code, line) location:
+    # line coverage only needs one observation, and disabling keeps the
+    # monitoring overhead near-zero on hot loops
+    f = code.co_filename
+    if _want(f):
+        _hits[f].add(line)
+    return sys.monitoring.DISABLE
+
+
+def executable_lines(path: Path) -> set[int]:
+    """All line numbers the compiled module can execute."""
+    try:
+        top = compile(path.read_text(), str(path), "exec")
+    except SyntaxError:
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _, _, line in code.co_lines():
+            if line is not None:
+                lines.add(line)
+        for const in code.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+    return lines
+
+
+def report(min_pct: float, worst_n: int) -> int:
+    rows = []
+    total_exec = total_hit = 0
+    for path in sorted(PKG.rglob("*.py")):
+        ex = executable_lines(path)
+        if not ex:
+            continue
+        hit = _hits.get(str(path), set()) & ex
+        total_exec += len(ex)
+        total_hit += len(hit)
+        rows.append((len(hit) / len(ex), str(path.relative_to(REPO)), len(hit), len(ex)))
+    rows.sort()
+    pct = 100.0 * total_hit / max(total_exec, 1)
+    print(f"\ncoverage: {pct:.1f}% ({total_hit}/{total_exec} lines, "
+          f"{len(rows)} files)", file=sys.stderr)
+    if worst_n:
+        print(f"least covered {worst_n}:", file=sys.stderr)
+        for frac, name, hit, ex in rows[:worst_n]:
+            print(f"  {100*frac:5.1f}%  {name} ({hit}/{ex})", file=sys.stderr)
+    if pct < min_pct:
+        print(f"coverage {pct:.1f}% below required {min_pct:.1f}%", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--min", type=float, default=0.0,
+                        help="fail when total coverage is below this percent")
+    parser.add_argument("--report", type=int, default=15,
+                        help="show the N least-covered files")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="-- followed by python args (e.g. -- -m pytest tests/)")
+    args = parser.parse_args()
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("pass the python invocation after --")
+
+    # running as `python tools/coverage.py` puts tools/ at sys.path[0];
+    # the measured package must import from the repo root
+    sys.path.insert(0, str(REPO))
+
+    sys.monitoring.use_tool_id(TOOL_ID, "consensus-tpu-coverage")
+    sys.monitoring.register_callback(
+        TOOL_ID, sys.monitoring.events.LINE, _on_line)
+    sys.monitoring.set_events(TOOL_ID, sys.monitoring.events.LINE)
+
+    status = 0
+    try:
+        if cmd[0] == "-m":
+            sys.argv = [cmd[1]] + cmd[2:]
+            runpy.run_module(cmd[1], run_name="__main__", alter_sys=True)
+        else:
+            sys.argv = cmd
+            runpy.run_path(cmd[0], run_name="__main__")
+    except SystemExit as exc:
+        # exc.code may be None (success), an int, or a message string
+        status = (exc.code if isinstance(exc.code, int)
+                  else (0 if exc.code is None else 1))
+    finally:
+        sys.monitoring.set_events(TOOL_ID, 0)
+        sys.monitoring.free_tool_id(TOOL_ID)
+    rc = report(args.min, args.report)
+    return status or rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
