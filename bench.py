@@ -162,7 +162,7 @@ def run_benches() -> dict:
         with timed("bench_attestations"):
             import benches.attestation_bench as att_bench
 
-            att_per_s, att_epoch_s, att_count = att_bench.run()
+            att_per_s, att_epoch_s, att_count, att_cold_s = att_bench.run()
         with timed("bench_state_root"):
             import benches.state_root_bench as sr_bench
 
@@ -189,8 +189,12 @@ def run_benches() -> dict:
             "bls_compile_s": round(compile_s, 1),
             "process_epoch_1m_s": round(epoch_s, 4),
             "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
-            "attestations_per_sec": round(att_per_s, 1),
-            "attestation_epoch_s": round(att_epoch_s, 4),
+            # cold = caches cleared (comparable with r1-r3 recordings);
+            # warm = marginal re-verification rate with caches hot
+            "attestations_per_sec": round(att_count / att_cold_s, 1),
+            "attestation_epoch_s": round(att_cold_s, 4),
+            "attestations_per_sec_warm": round(att_per_s, 1),
+            "attestation_warm_epoch_s": round(att_epoch_s, 4),
             "attestations_per_epoch": att_count,
             "attestation_validators": att_bench.default_validators(),
             # BASELINE config 4 honest end-to-end: bridge + device epoch +

@@ -7,14 +7,21 @@ actual spec path:
 
   1. committees come from `spec.get_beacon_committee`, whose shuffle the
      compiled spec routes through the device kernel (`accelerated_shuffle`
-     -> ops/shuffle.py); the epoch's shuffle cache is cleared before the
-     timed region, so the measured epoch pays its own shuffle launch;
+     -> ops/shuffle.py);
   2. the state advances slot by slot (`process_slots` — cheap re-roots via
      the incremental Merkle trees) and every aggregate is applied with
      `spec.process_attestation` (pending-attestation bookkeeping included)
      under `bls.deferred_verification()` with the jax backend;
   3. ONE flush at epoch end batch-verifies every aggregate on device
      (randomized shared-final-exp for large batches).
+
+  TWO epochs are measured. COLD: shuffle + BLS host-prep caches cleared
+  — pays the epoch's shuffle launch, per-committee pubkey aggregation,
+  per-message hash-to-curve and signature decompression (what the first
+  sight of an attestation set costs; comparable with pre-r4 recordings).
+  WARM: caches hot — the marginal cost of re-verifying a set already
+  seen once (gossip acceptance then block import), the steady-state
+  per-sighting rate. The headline `value` is the COLD rate.
 
 Attestations are REAL: full-participation aggregates over the committee
 members' registry pubkeys, signed via the aggregate identity
@@ -92,7 +99,8 @@ def _apply_epoch(spec, state, attestations):
 
 
 def run(n_validators: int | None = None):
-    """Returns (attestations_per_sec, epoch_wallclock_s, n_attestations)."""
+    """Returns (warm attestations/sec, warm epoch s, n_attestations,
+    cold epoch s)."""
     from consensus_specs_tpu.compiler import get_spec
     from consensus_specs_tpu.crypto import bls
     from consensus_specs_tpu.testlib.big_state import synthetic_beacon_state
@@ -115,40 +123,58 @@ def run(n_validators: int | None = None):
     print(f"# signed {len(attestations)} real aggregates: {time.time() - t0:.1f}s",
           file=sys.stderr)
 
+    from consensus_specs_tpu.crypto import bls_jax
+
     prev_active, prev_backend = bls.bls_active, bls.backend()
     bls.bls_active = True
     bls.use_jax()
     try:
         # warm-up run on a copy: compiles the pairing/shuffle programs for
-        # the exact bucketed shapes the measured epoch uses
+        # the exact bucketed shapes the measured epochs use
         t0 = time.time()
         _apply_epoch(spec, state.copy(), attestations)
         print(f"# warm-up epoch (incl. compiles): {time.time() - t0:.1f}s",
               file=sys.stderr)
 
-        spec._SHUFFLE_CACHE.clear()  # the measured epoch pays its own shuffle
+        # COLD epoch: fresh caches — pays the epoch's shuffle launch, every
+        # committee aggregation, hash-to-curve per message, and signature
+        # decompression (what the FIRST sight of an attestation set costs)
+        spec._SHUFFLE_CACHE.clear()
+        bls_jax._AGG_CACHE.clear()
+        bls_jax.hash_to_curve_g2.cache_clear()
+        bls_jax.g2_from_bytes.cache_clear()
         flushes0 = bls.flush_count
+        cold_state = state.copy()
+        t0 = time.time()
+        _apply_epoch(spec, cold_state, attestations)
+        cold_s = time.time() - t0
+        assert bls.flush_count == flushes0 + 1, "expected exactly one epoch flush"
+
+        # WARM epoch: caches hot — the marginal re-verification cost. Every
+        # real attestation is verified at least twice (gossip acceptance,
+        # then block import), so this is the steady-state per-sighting rate.
         t0 = time.time()
         _apply_epoch(spec, state, attestations)
-        epoch_s = time.time() - t0
-        assert bls.flush_count == flushes0 + 1, "expected exactly one epoch flush"
+        warm_s = time.time() - t0
     finally:
         bls.bls_active = prev_active
         bls.use_py() if prev_backend == "py" else bls.use_jax()
 
     n_att = len(attestations)
-    return n_att / epoch_s, epoch_s, n_att
+    return n_att / warm_s, warm_s, n_att, cold_s
 
 
 def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else default_validators()
-    aps, epoch_s, n_att = run(n)
+    warm_aps, warm_s, n_att, cold_s = run(n)
     print(json.dumps({
         "metric": "attestation_processing_throughput",
-        "value": round(aps, 1),
+        "value": round(n_att / cold_s, 1),  # cold: comparable with pre-r4
         "unit": "attestations/sec/chip",
         "vs_baseline": None,
-        "epoch_wallclock_s": round(epoch_s, 4),
+        "epoch_wallclock_s": round(cold_s, 4),
+        "warm_epoch_wallclock_s": round(warm_s, 4),
+        "attestations_per_sec_warm": round(warm_aps, 1),
         "attestations_per_epoch": n_att,
         "validators": n,
     }))
