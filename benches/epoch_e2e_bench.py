@@ -116,9 +116,24 @@ def run(n_validators: int | None = None):
         eng.step_epoch()
         jax.block_until_ready(eng.dev.balances)
         res_times.append(time.time() - t0)
+    # device-side state root (engine/state_root.py): per-epoch root with
+    # the registry still resident — first call pays the static-leaf build
+    # + compile, the second is the steady-state cost
+    t0 = time.time()
+    eng.state_root()
+    resident_root_first_s = time.time() - t0
+    t0 = time.time()
+    root_bytes = eng.state_root()
+    resident_root_steady_s = time.time() - t0
+    print(f"# resident state_root: first {resident_root_first_s:.2f}s, "
+          f"steady {resident_root_steady_s:.4f}s", file=sys.stderr)
+
     t0 = time.time()
     eng.materialize()
     materialize_s = time.time() - t0
+    from consensus_specs_tpu.ssz import hash_tree_root as _htr
+
+    assert root_bytes == bytes(_htr(state)), "device root != host tree"
     t0 = time.time()
     root = hash_tree_root(state)
     resident_root_s = time.time() - t0
@@ -133,6 +148,8 @@ def run(n_validators: int | None = None):
         "stages_s": {k: round(v, 3) for k, v in stages.items()},
         "resident_epoch_s": round(res_epoch_s, 4),
         "resident_epochs": n_resident,
+        "resident_state_root_s": round(resident_root_steady_s, 4),
+        "resident_state_root_first_s": round(resident_root_first_s, 3),
         "resident_amortized_epoch_s": round(
             (sum(res_times) + materialize_s + resident_root_s) / n_resident, 4),
         "resident_bridge_in_s": round(resident_in_s, 3),

@@ -136,3 +136,24 @@ class ResidentEpochEngine:
         sequential loop (diff-based registry update + bulk vectors)."""
         bridge._write_back(self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes)
         self._pre_mixes = np.asarray(self.dev.randao_mixes)
+
+    def state_root(self) -> bytes:
+        """hash_tree_root(BeaconState) WITHOUT materializing.
+
+        The registry-scale subtrees (validators, balances, participation,
+        inactivity, the root vectors and checkpoints) merkleize on device
+        in one jitted launch (engine/state_root.py); only their 32-byte
+        roots cross to the host, where they merge with the host-owned
+        field roots (genesis data, eth1, historical accumulator, sync
+        committees — all kept current by the step epilogues). Bit-equal
+        to materialize()+hash_tree_root (tests/test_resident_engine.py)."""
+        from .state_root import (
+            assemble_state_root,
+            state_root_fn,
+            validator_static_leaves,
+        )
+
+        if not hasattr(self, "_static_leaves"):
+            self._static_leaves = jnp.asarray(validator_static_leaves(self.state))
+        roots = state_root_fn()(self.dev, self._static_leaves)
+        return assemble_state_root(self.spec, self.state, jax.device_get(roots))

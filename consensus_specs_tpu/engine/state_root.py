@@ -1,0 +1,263 @@
+"""Device-side BeaconState root for the resident epoch engine.
+
+The sequential bridge pays a full write-back before every state root; the
+resident engine (engine/resident.py) keeps the registry in HBM, so the
+per-epoch state root must come from the DEVICE copy. This module computes
+the Merkle roots of every registry-scale field on the TPU — the validator
+containers (3 batched sha levels over N 8-leaf trees), the uint64/uint8
+list bodies, and the small vectors — in ONE jitted program per
+(config, N), and the host assembles the final container root from those
+plus the host-owned fields (genesis data, eth1, sync committees,
+historical accumulator), which the resident epilogues keep current.
+
+SSZ parity: bit-identical with `ssz.hash_tree_root(state)` — list bodies
+merkleize to their LIMIT depth via precomputed zero-subtree roots and mix
+in their length; Bytes48 pubkey roots and withdrawal credentials are
+static per validator and uploaded once. Asserted against the host tree in
+tests/test_resident_engine.py.
+
+Reference parity: the role of remerkleable's cached tree re-rooting after
+an epoch transition — re-expressed as a batched device Merkle sweep
+(~2N sha256 for the registry) instead of a host pointer-tree walk.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.sha256_jax import (
+    merkle_parent_level,
+    sha256_64B_words,
+    words_to_bytes,
+)
+from ..ssz.merkle import zerohashes
+
+U32 = jnp.uint32
+
+# List limits are VALIDATOR_REGISTRY_LIMIT = 2^40 entries for every
+# registry-scale list (phase0/altair BeaconState): chunk-tree depths are
+#   uint64 body:  2^40 / 4  per chunk -> depth 38
+#   uint8  body:  2^40 / 32 per chunk -> depth 35
+#   validator containers: one chunk per root -> depth 40
+DEPTH_U64 = 38
+DEPTH_U8 = 35
+DEPTH_VALIDATORS = 40
+
+_ZERO_WORDS = np.stack([
+    np.frombuffer(z, dtype=">u4").astype(np.uint32) for z in zerohashes[:64]
+])
+
+
+def _bswap32(x: jax.Array) -> jax.Array:
+    x = x.astype(U32)
+    return (
+        ((x & U32(0x000000FF)) << 24)
+        | ((x & U32(0x0000FF00)) << 8)
+        | ((x & U32(0x00FF0000)) >> 8)
+        | ((x & U32(0xFF000000)) >> 24)
+    )
+
+
+def _u64_chunk_words(a: jax.Array) -> jax.Array:
+    """(N,) uint64 -> (ceil(N/4), 8) sha word chunks (SSZ little-endian
+    packing read as big-endian u32 stream)."""
+    n = a.shape[0]
+    pad = (-n) % 4
+    if pad:
+        a = jnp.concatenate([a, jnp.zeros(pad, dtype=a.dtype)])
+    lo = _bswap32((a & jnp.uint64(0xFFFFFFFF)).astype(U32))
+    hi = _bswap32((a >> jnp.uint64(32)).astype(U32))
+    inter = jnp.stack([lo, hi], axis=-1).reshape(-1)  # w0 w1 per u64
+    return inter.reshape(-1, 8)
+
+
+def _u64_single_chunk(x: jax.Array) -> jax.Array:
+    """() uint64 -> (8,) word chunk."""
+    return _u64_chunk_words(x[None])[0]
+
+
+def _u8_chunk_words(a: jax.Array) -> jax.Array:
+    """(N,) uint8 -> (ceil(N/32), 8) sha word chunks."""
+    n = a.shape[0]
+    pad = (-n) % 32
+    if pad:
+        a = jnp.concatenate([a, jnp.zeros(pad, dtype=a.dtype)])
+    b = a.reshape(-1, 8, 4).astype(U32)
+    words = (b[..., 0] << 24) | (b[..., 1] << 16) | (b[..., 2] << 8) | b[..., 3]
+    return words.reshape(-1, 8)
+
+
+def _bool_chunk_words(a: jax.Array) -> jax.Array:
+    return _u8_chunk_words(a.astype(jnp.uint8))
+
+
+def _tree_root(chunks: jax.Array) -> jax.Array:
+    """(C, 8) chunk words -> ((8,), depth) root of the 2^ceil(log2 C) tree.
+
+    C is static; zero-chunk padding to the next power of two is explicit
+    (zero chunks, NOT zero hashes — these are leaves)."""
+    c = chunks.shape[0]
+    depth = max(1, (c - 1)).bit_length() if c > 1 else 0
+    full = 1 << depth
+    if full != c:
+        chunks = jnp.concatenate(
+            [chunks, jnp.zeros((full - c, 8), dtype=chunks.dtype)])
+    nodes = chunks
+    for _ in range(depth):
+        nodes = merkle_parent_level(nodes)
+    return nodes[0], depth
+
+
+def _extend(root: jax.Array, from_depth: int, to_depth: int) -> jax.Array:
+    """Fold the root up to `to_depth` against zero-subtree roots."""
+    zw = jnp.asarray(_ZERO_WORDS)
+    for d in range(from_depth, to_depth):
+        root = sha256_64B_words(jnp.concatenate([root, zw[d]])[None])[0]
+    return root
+
+
+def _mix_len(root: jax.Array, n: int) -> jax.Array:
+    len_chunk = _u64_single_chunk(jnp.uint64(n))
+    return sha256_64B_words(jnp.concatenate([root, len_chunk])[None])[0]
+
+
+def _list_root_u64(a: jax.Array) -> jax.Array:
+    root, depth = _tree_root(_u64_chunk_words(a))
+    return _mix_len(_extend(root, depth, DEPTH_U64), a.shape[0])
+
+
+def _list_root_u8(a: jax.Array) -> jax.Array:
+    root, depth = _tree_root(_u8_chunk_words(a))
+    return _mix_len(_extend(root, depth, DEPTH_U8), a.shape[0])
+
+
+def _vector_root_words(rows: jax.Array) -> jax.Array:
+    """(S, 8) chunk/root words, S = 2^k -> (8,)."""
+    nodes = rows
+    while nodes.shape[0] > 1:
+        nodes = merkle_parent_level(nodes)
+    return nodes[0]
+
+
+def _validators_root(static01: jax.Array, st) -> jax.Array:
+    """Registry list root from per-validator 8-leaf trees.
+
+    static01: (N, 16) words — H(pubkey) root ‖ withdrawal_credentials per
+    validator (leaves 0,1 concatenated, precomputed host-side once: both
+    are immutable per index). The six dynamic leaves come from the
+    resident EpochState columns."""
+    n = st.balances.shape[0]
+    zeros6 = jnp.zeros((n, 6), dtype=U32)
+
+    def chunk(col):
+        lo = _bswap32((col.astype(jnp.uint64) & jnp.uint64(0xFFFFFFFF)).astype(U32))
+        hi = _bswap32((col.astype(jnp.uint64) >> jnp.uint64(32)).astype(U32))
+        return jnp.concatenate([lo[:, None], hi[:, None], zeros6], axis=1)
+
+    def bchunk(col):  # boolean leaf: one byte
+        b = (col.astype(U32) & U32(1)) << 24
+        return jnp.concatenate([b[:, None], jnp.zeros((n, 7), dtype=U32)], axis=1)
+
+    h01 = sha256_64B_words(static01)
+    h23 = sha256_64B_words(
+        jnp.concatenate([chunk(st.effective_balance), bchunk(st.slashed)], axis=1))
+    h45 = sha256_64B_words(
+        jnp.concatenate(
+            [chunk(st.activation_eligibility_epoch), chunk(st.activation_epoch)], axis=1))
+    h67 = sha256_64B_words(
+        jnp.concatenate([chunk(st.exit_epoch), chunk(st.withdrawable_epoch)], axis=1))
+    top = sha256_64B_words(jnp.concatenate([
+        sha256_64B_words(jnp.concatenate([h01, h23], axis=1)),
+        sha256_64B_words(jnp.concatenate([h45, h67], axis=1)),
+    ], axis=1))  # (N, 8) per-validator container roots
+    root, depth = _tree_root(top)
+    return _mix_len(_extend(root, depth, DEPTH_VALIDATORS), n)
+
+
+def _checkpoint_root(epoch: jax.Array, root_words: jax.Array) -> jax.Array:
+    return sha256_64B_words(
+        jnp.concatenate([_u64_single_chunk(epoch), root_words])[None])[0]
+
+
+def make_state_root_fn():
+    """jit: (EpochState, static01) -> dict of device-owned field roots.
+    jit itself specializes per input shape, so one module-level instance
+    serves every (config, N)."""
+
+    def field_roots(st, static01):
+        bits = st.justification_bits.astype(jnp.uint8)
+        weights = jnp.asarray(np.array([1, 2, 4, 8], dtype=np.uint8))
+        jb_byte = jnp.sum(bits * weights).astype(jnp.uint8)
+        return {
+            "slot": _u64_single_chunk(st.slot),
+            "validators": _validators_root(static01, st),
+            "balances": _list_root_u64(st.balances),
+            "inactivity_scores": _list_root_u64(st.inactivity_scores),
+            "previous_epoch_participation": _list_root_u8(st.prev_participation),
+            "current_epoch_participation": _list_root_u8(st.curr_participation),
+            "slashings": _vector_root_words(_u64_chunk_words(st.slashings)),
+            "randao_mixes": _vector_root_words(st.randao_mixes),
+            "block_roots": _vector_root_words(st.block_roots),
+            "state_roots": _vector_root_words(st.state_roots),
+            "justification_bits": _u8_chunk_words(jb_byte[None])[0],
+            "previous_justified_checkpoint": _checkpoint_root(
+                st.prev_justified_epoch, st.prev_justified_root),
+            "current_justified_checkpoint": _checkpoint_root(
+                st.curr_justified_epoch, st.curr_justified_root),
+            "finalized_checkpoint": _checkpoint_root(
+                st.finalized_epoch, st.finalized_root),
+        }
+
+    return jax.jit(field_roots)
+
+
+@lru_cache(maxsize=1)
+def state_root_fn():
+    return make_state_root_fn()
+
+
+def validator_static_leaves(state) -> np.ndarray:
+    """(N, 16) words: hash_tree_root(pubkey) ‖ withdrawal_credentials per
+    validator — the two immutable leaves of every Validator container,
+    computed once per registry on host. The N pubkey roots (each exactly
+    sha256 of one 64-byte block: the 48 key bytes + 16 zero bytes) go
+    through the batched pair hasher (native SHA-NI / numpy kernel) in a
+    single pass instead of N hashlib calls."""
+    from ..ssz.merkle import hash_pairs_blob
+
+    vals = state.validators
+    n = len(vals)
+    pk_blob = b"".join(bytes(v.pubkey) + b"\x00" * 16 for v in vals)
+    pk_roots = hash_pairs_blob(pk_blob)  # (n * 32 bytes)
+    wc_blob = b"".join(bytes(v.withdrawal_credentials) for v in vals)
+    out = np.zeros((n, 16), dtype=np.uint32)
+    out[:, :8] = np.frombuffer(pk_roots, dtype=">u4").astype(np.uint32).reshape(n, 8)
+    out[:, 8:] = np.frombuffer(wc_blob, dtype=">u4").astype(np.uint32).reshape(n, 8)
+    return out
+
+
+DEVICE_FIELDS = frozenset({
+    "slot", "validators", "balances", "inactivity_scores",
+    "previous_epoch_participation", "current_epoch_participation",
+    "slashings", "randao_mixes", "block_roots", "state_roots",
+    "justification_bits", "previous_justified_checkpoint",
+    "current_justified_checkpoint", "finalized_checkpoint",
+})
+
+
+def assemble_state_root(spec, state, device_roots: dict) -> bytes:
+    """Container root: device-owned field roots (fetched words) merged with
+    host-owned field roots from the (epilogue-maintained) state object."""
+    from ..ssz import hash_tree_root
+    from ..ssz.merkle import merkleize_chunks
+
+    chunks = []
+    for name in type(state).fields():
+        if name in DEVICE_FIELDS:
+            chunks.append(words_to_bytes(np.asarray(device_roots[name])))
+        else:
+            chunks.append(bytes(hash_tree_root(getattr(state, name))))
+    return merkleize_chunks(chunks)

@@ -87,3 +87,52 @@ def test_resident_state_stale_until_materialize(spec):
         assert [int(b) for b in st.balances] != before  # rewards applied
     finally:
         bls.bls_active = was
+
+
+def test_resident_state_root_matches_host_tree(spec):
+    """Device-side state root (engine/state_root.py): bit-equal to the
+    host SSZ tree, across several epochs and every period epilogue."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = _prepared_state(spec, start_epoch=6, seed=5)
+        eng = ResidentEpochEngine(spec, st)
+        for _ in range(4):
+            eng.step_epoch()
+            eng.state_root()  # well-defined at every intermediate epoch
+        eng_root = eng.state_root()
+        eng.materialize()
+        host_root = bytes(hash_tree_root(st))
+        assert eng_root == host_root
+    finally:
+        bls.bls_active = was
+
+
+def test_resident_state_root_bellatrix(spec):
+    """The generic field-root assembly covers bellatrix's extra
+    (host-owned) execution-payload-header field."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        bspec = get_spec("bellatrix", "minimal")
+        st = _prepared_state(bspec, start_epoch=6, seed=4)
+        eng = ResidentEpochEngine(bspec, st)
+        eng.step_epoch()
+        root = eng.state_root()
+        eng.materialize()
+        assert root == bytes(hash_tree_root(st))
+    finally:
+        bls.bls_active = was
+
+
+def test_resident_state_root_before_any_step(spec):
+    """Root agreement at the bridge-in point (no epoch run yet)."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = _prepared_state(spec, start_epoch=6, seed=9)
+        expected = bytes(hash_tree_root(st))
+        eng = ResidentEpochEngine(spec, st)
+        assert eng.state_root() == expected
+    finally:
+        bls.bls_active = was

@@ -19,7 +19,6 @@ falls below --min.
 from __future__ import annotations
 
 import argparse
-import os
 import runpy
 import sys
 from collections import defaultdict
