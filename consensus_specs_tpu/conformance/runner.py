@@ -293,10 +293,17 @@ def _replay_genesis(spec, case_dir, handler, meta):
         eth1 = _read_yaml(case_dir, "eth1")
         n = int((meta or {})["deposits_count"])
         deposits = [_read_ssz(case_dir, f"deposits_{i}", spec.Deposit) for i in range(n)]
+        kwargs = {}
+        if (meta or {}).get("execution_payload_header"):
+            # bellatrix merged-from-genesis cases carry the caller-chosen
+            # header as an extra ssz part (reference format)
+            kwargs["execution_payload_header"] = _read_ssz(
+                case_dir, "execution_payload_header", spec.ExecutionPayloadHeader)
         state = spec.initialize_beacon_state_from_eth1(
             spec.Hash32(bytes.fromhex(eth1["eth1_block_hash"][2:])),
             spec.uint64(eth1["eth1_timestamp"]),
             deposits,
+            **kwargs,
         )
         expected = _read_ssz(case_dir, "state", spec.BeaconState)
         assert spec.hash_tree_root(state) == spec.hash_tree_root(expected)

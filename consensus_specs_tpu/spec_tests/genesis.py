@@ -8,7 +8,7 @@ genesis.ssz_snappy + is_valid.yaml.
 Reference parity: test/phase0/genesis/test_initialization.py,
 test_validity.py.
 """
-from ..testlib.context import ALTAIR, PHASE0, spec_test, with_phases
+from ..testlib.context import ALTAIR, BELLATRIX, PHASE0, spec_test, with_phases
 from ..testlib.deposits import prepare_genesis_deposits
 
 ETH1_BLOCK_HASH = b"\x12" * 32
@@ -114,5 +114,58 @@ def test_initialize_beacon_state_from_eth1_altair(spec):
     expected = spec.get_next_sync_committee(state)
     assert bytes(state.current_sync_committee.hash_tree_root()) == bytes(expected.hash_tree_root())
     assert bytes(state.next_sync_committee.hash_tree_root()) == bytes(expected.hash_tree_root())
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([BELLATRIX])
+@spec_test
+
+def test_initialize_beacon_state_from_eth1_bellatrix_pre_merge(spec):
+    """Bellatrix override, default (empty) payload header: a chain that
+    has NOT yet merged — transition machinery armed."""
+    deposits, deposit_root = prepare_genesis_deposits(spec, _min_count(spec))
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + ETH1_BLOCK_HASH.hex(),
+        "eth1_timestamp": ETH1_TIMESTAMP,
+    }
+    yield "meta", "meta", {"deposits_count": len(deposits)}
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits
+    )
+    assert state.eth1_data.deposit_root == deposit_root
+    assert bytes(state.fork.current_version) == bytes(spec.config.BELLATRIX_FORK_VERSION)
+    assert state.latest_execution_payload_header == spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+    expected = spec.get_next_sync_committee(state)
+    assert bytes(state.current_sync_committee.hash_tree_root()) == bytes(expected.hash_tree_root())
+    assert spec.is_valid_genesis_state(state)
+    yield "state", state
+
+
+@with_phases([BELLATRIX])
+@spec_test
+
+def test_initialize_beacon_state_from_eth1_bellatrix_post_merge(spec):
+    """Non-default payload header: merged from genesis."""
+    deposits, _ = prepare_genesis_deposits(spec, _min_count(spec))
+    header = spec.ExecutionPayloadHeader(
+        block_hash=spec.Hash32(b"\x22" * 32), block_number=spec.uint64(1))
+    yield "eth1", "data", {
+        "eth1_block_hash": "0x" + ETH1_BLOCK_HASH.hex(),
+        "eth1_timestamp": ETH1_TIMESTAMP,
+    }
+    yield "meta", "meta", {"deposits_count": len(deposits),
+                           "execution_payload_header": True}
+    for i, d in enumerate(deposits):
+        yield f"deposits_{i}", d
+    yield "execution_payload_header", header
+    state = spec.initialize_beacon_state_from_eth1(
+        spec.Hash32(ETH1_BLOCK_HASH), spec.uint64(ETH1_TIMESTAMP), deposits,
+        execution_payload_header=header,
+    )
+    assert spec.is_merge_transition_complete(state)
     assert spec.is_valid_genesis_state(state)
     yield "state", state

@@ -16,8 +16,12 @@ ALL_MODS = {
         "initialization": (genesis, "initialize_"),
         "validity": (genesis, "validity_"),
     },
-    # altair genesis override: sync committees sampled at initialization
+    # altair/bellatrix genesis overrides: sync committees at genesis;
+    # bellatrix adds the caller-selected merge status
     "altair": {
+        "initialization": (genesis, "initialize_"),
+    },
+    "bellatrix": {
         "initialization": (genesis, "initialize_"),
     },
 }
