@@ -205,6 +205,7 @@ def run_benches() -> dict:
             # steady-state device-resident loop (engine/resident.py): the
             # registry never leaves HBM; materialize + root amortized
             "epoch_resident_s": e2e["resident_epoch_s"],
+            "epoch_resident_scan_s": e2e["resident_scan_epoch_s"],
             "epoch_resident_state_root_s": e2e["resident_state_root_s"],
             "epoch_resident_amortized_s": e2e["resident_amortized_epoch_s"],
             "epoch_resident_epochs": e2e["resident_epochs"],

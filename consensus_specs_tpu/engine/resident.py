@@ -37,22 +37,46 @@ from .epoch import historical_batch_root, make_epoch_fn
 from .state import EpochConfig
 
 
-@lru_cache(maxsize=None)
-def resident_step_fn_for(cfg: EpochConfig):
-    """jit `process_epoch` + the inter-epoch slot advance, input donated.
-
-    The spec calls `process_epoch` at the last slot of each epoch and
-    `process_slots` then advances the slot; consecutive transitions are
-    exactly SLOTS_PER_EPOCH apart, so the resident step folds the advance
-    into the same XLA program and the state never leaves HBM.
-    """
+def _step_body(cfg: EpochConfig):
+    """The shared un-jitted resident step: `process_epoch` + the
+    inter-epoch slot advance. The spec calls `process_epoch` at the last
+    slot of each epoch and `process_slots` then advances the slot;
+    consecutive transitions are exactly SLOTS_PER_EPOCH apart, so the
+    step folds the advance into the same XLA program and the state never
+    leaves HBM. Single source for both the per-epoch and the scan jits."""
     epoch_fn = make_epoch_fn(cfg, with_jit=False)
+    spe = jnp.uint64(cfg.slots_per_epoch)
 
     def step(st):
         st, aux = epoch_fn(st)
-        return st.replace(slot=st.slot + jnp.uint64(cfg.slots_per_epoch)), aux
+        return st.replace(slot=st.slot + spe), aux
 
-    return jax.jit(step, donate_argnums=(0,))
+    return step
+
+
+@lru_cache(maxsize=None)
+def resident_step_fn_for(cfg: EpochConfig):
+    """jit one resident step, input donated."""
+    return jax.jit(_step_body(cfg), donate_argnums=(0,))
+
+
+@lru_cache(maxsize=None)
+def resident_scan_fn_for(cfg: EpochConfig, k: int):
+    """jit a `lax.scan` of k resident steps: ONE device launch and ONE
+    aux readout for k epochs.
+
+    Through a high-latency link (the TPU tunnel) per-epoch dispatch plus
+    the three-bool readout costs a round trip per epoch; the scan form
+    pays it once per SEGMENT. Segments never cross a sync-committee
+    period boundary (run_epochs slices them so), which is what makes
+    deferred epilogue servicing exact — see ResidentEpochEngine.run_epochs.
+    """
+    step = _step_body(cfg)
+
+    def scan_k(st):
+        return jax.lax.scan(lambda c, _: step(c), st, None, length=k)
+
+    return jax.jit(scan_k, donate_argnums=(0,))
 
 
 class ResidentEpochEngine:
@@ -85,18 +109,72 @@ class ResidentEpochEngine:
         """One epoch transition; host work is O(1) except on period
         boundaries (see module docstring)."""
         self.dev, aux = self._step(self.dev)
-        # Three () bools: the only unconditional device->host readout.
-        if bool(aux.eth1_votes_reset):
+        self._service_segment(
+            np.asarray(aux.eth1_votes_reset)[None],
+            np.asarray(aux.historical_append)[None],
+            np.asarray(aux.sync_committee_update)[None],
+        )
+
+    def _service_segment(self, eth1_resets, hist_appends, sync_updates) -> None:
+        """Host epilogues + slot-mirror advance for a segment of epochs,
+        given the (seg,) aux flag arrays. Shared by step_epoch (seg=1) and
+        run_epochs — the deferral-correctness argument lives on run_epochs."""
+        seg = len(eth1_resets)
+        if eth1_resets.any():
             self.state.eth1_data_votes = type(self.state.eth1_data_votes)()
-        if bool(aux.historical_append):
-            root = bridge._words_to_root(
-                np.asarray(historical_batch_root(self.dev.block_roots, self.dev.state_roots))
-            )
-            self.state.historical_roots.append(self.spec.Root(root))
-        if bool(aux.sync_committee_update):
+        if hist_appends.any():
+            root = bridge._words_to_root(np.asarray(historical_batch_root(
+                self.dev.block_roots, self.dev.state_roots)))
+            for _ in range(int(hist_appends.sum())):
+                self.state.historical_roots.append(self.spec.Root(root))
+        if sync_updates.any():
+            # segment slicing guarantees the rotation fires only at the
+            # segment's LAST epoch, so device columns are current for it
+            assert sync_updates[-1] and int(sync_updates.sum()) == 1
+            self.state.slot += self.spec.SLOTS_PER_EPOCH * (seg - 1)
             self._rotate_sync_committees_resident()
-        # Mirror the slot advance the jitted step applied on device.
-        self.state.slot += self.spec.SLOTS_PER_EPOCH
+            self.state.slot += self.spec.SLOTS_PER_EPOCH
+        else:
+            self.state.slot += self.spec.SLOTS_PER_EPOCH * seg
+
+    def run_epochs(self, k: int) -> None:
+        """k epoch transitions in as few device launches as possible.
+
+        Epochs are scanned on device in SEGMENTS that end at (and never
+        cross) sync-committee period boundaries, because the rotation
+        epilogue must read the registry columns AS OF its firing epoch —
+        every other epilogue is exactly servable after the fact:
+
+        - eth1 reset: clearing the host vote list is idempotent and the
+          engine model adds no votes between epochs, so servicing the
+          resets at segment end equals servicing them inline;
+        - historical append: the epoch program never writes block_roots /
+          state_roots (those are process_slot effects, host-side), so
+          the HistoricalBatch root is invariant across a segment and the
+          append(s) can fire late with identical values;
+        - sync rotation: NOT deferrable past its epoch (registry churn
+          between the boundary and segment end would change the sampled
+          committee) — hence the segment slicing, which the host can do
+          statically from the period schedule.
+
+        Flag readout is one (seg_len, 3) fetch per segment instead of
+        three bools per epoch.
+        """
+        period = self.cfg.epochs_per_sync_committee_period
+        done = 0
+        while done < k:
+            # epochs remaining in the CURRENT period (next_epoch = cur+1
+            # triggers rotation when it hits a multiple of the period)
+            cur = int(self.state.slot) // self.cfg.slots_per_epoch
+            to_boundary = period - 1 - (cur % period) + 1  # epochs incl. the one firing rotation
+            seg = min(k - done, to_boundary)
+            self.dev, auxes = resident_scan_fn_for(self.cfg, seg)(self.dev)
+            self._service_segment(
+                np.asarray(auxes.eth1_votes_reset),
+                np.asarray(auxes.historical_append),
+                np.asarray(auxes.sync_committee_update),
+            )
+            done += seg
 
     def _rotate_sync_committees_resident(self) -> None:
         """`process_sync_committee_updates` against device-current data.

@@ -136,3 +136,29 @@ def test_resident_state_root_before_any_step(spec):
         assert eng.state_root() == expected
     finally:
         bls.bls_active = was
+
+
+@pytest.mark.parametrize("k_epochs", [5, 17])
+def test_run_epochs_scan_matches_stepwise(spec, k_epochs):
+    """The lax.scan segment runner (run_epochs) is bit-equal to k
+    step_epoch calls — k=17 from epoch 6 crosses TWO sync-committee
+    rotations plus eth1 resets and historical appends on minimal."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st_a = _prepared_state(spec, start_epoch=6, seed=21)
+        st_b = st_a.copy()
+
+        eng_a = ResidentEpochEngine(spec, st_a)
+        for _ in range(k_epochs):
+            eng_a.step_epoch()
+        eng_a.materialize()
+
+        eng_b = ResidentEpochEngine(spec, st_b)
+        eng_b.run_epochs(k_epochs)
+        eng_b.materialize()
+
+        assert int(st_a.slot) == int(st_b.slot)
+        assert bytes(hash_tree_root(st_a)) == bytes(hash_tree_root(st_b))
+    finally:
+        bls.bls_active = was
