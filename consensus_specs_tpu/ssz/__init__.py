@@ -20,7 +20,15 @@ from .gindex import (  # noqa: F401
     get_generalized_index, get_generalized_index_bit,
     get_generalized_index_length,
 )
-from .proofs import build_proof, is_valid_merkle_branch  # noqa: F401
+from .proofs import (  # noqa: F401
+    build_multiproof,
+    build_proof,
+    calculate_multi_merkle_root,
+    get_helper_indices,
+    get_subtree_node_root,
+    is_valid_merkle_branch,
+    verify_multiproof,
+)
 
 
 def serialize(obj) -> bytes:

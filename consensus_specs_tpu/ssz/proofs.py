@@ -137,16 +137,20 @@ def build_proof(value, gindex: int) -> list[bytes]:
     return list(reversed(proof))
 
 
-def get_subtree_node_root(value, gindex: int) -> bytes:
-    """Root of the node addressed by gindex (for tests / leaf extraction)."""
+def _node_root_at(node, gindex: int) -> bytes:
+    """Root of the node addressed by gindex within an already-built tree."""
     if gindex < 1:
         raise ValueError("generalized index must be >= 1")
     bits = [(gindex >> i) & 1 for i in range(gindex.bit_length() - 2, -1, -1)]
-    node = to_node(value)
     for b in bits:
         node = node_deref(node)
         node = node_child(node, bool(b))
     return node_root(node)
+
+
+def get_subtree_node_root(value, gindex: int) -> bytes:
+    """Root of the node addressed by gindex (for tests / leaf extraction)."""
+    return _node_root_at(to_node(value), gindex)
 
 
 def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: bytes) -> bool:
@@ -159,3 +163,100 @@ def is_valid_merkle_branch(leaf: bytes, branch, depth: int, index: int, root: by
         else:
             value = hash_eth2(value + bytes(branch[i]))
     return value == bytes(root)
+
+
+# --- multiproofs (reference parity: ssz/merkle-proofs.md multiproof
+# section — get_helper_indices / calculate_multi_merkle_root) ---------------
+
+
+def get_branch_indices(tree_index: int) -> list:
+    """Sibling of every node on the path from `tree_index` to the root,
+    deepest first."""
+    out = []
+    while tree_index > 1:
+        out.append(tree_index ^ 1)
+        tree_index //= 2
+    return out
+
+
+def get_path_indices(tree_index: int) -> list:
+    """`tree_index` and its ancestors, up to but excluding the root."""
+    out = []
+    while tree_index > 1:
+        out.append(tree_index)
+        tree_index //= 2
+    return out
+
+
+def _check_independent(indices) -> None:
+    """Reject ill-formed leaf sets where one requested index is an
+    ancestor of another (its subtree already contains the descendant —
+    the request is contradictory, not deduplicable)."""
+    index_set = set(indices)
+    if len(index_set) != len(indices):
+        raise ValueError("duplicate generalized indices")
+    for g in indices:
+        anc = g // 2
+        while anc >= 1:
+            if anc in index_set:
+                raise ValueError(f"index {anc} is an ancestor of {g}")
+            anc //= 2
+
+
+def get_helper_indices(indices) -> list:
+    """Minimal helper-node set for a multiproof over `indices`: every
+    path sibling not itself derivable from the leaves or other helpers,
+    sorted by DESCENDING generalized index (children before parents)."""
+    all_helper_indices: set = set()
+    all_path_indices: set = set()
+    for index in indices:
+        all_helper_indices.update(get_branch_indices(index))
+        all_path_indices.update(get_path_indices(index))
+    return sorted(all_helper_indices - all_path_indices, reverse=True)
+
+
+def build_multiproof(value, gindices) -> list:
+    """Helper-node hashes proving all of `gindices` at once, in
+    get_helper_indices order. For a single index this degenerates to
+    build_proof's branch, deepest-first. The typed node tree is built
+    ONCE and every helper walk shares it."""
+    _check_independent(gindices)
+    tree = to_node(value)
+    return [_node_root_at(tree, h) for h in get_helper_indices(gindices)]
+
+
+def calculate_multi_merkle_root(leaves, proof, indices) -> bytes:
+    """Root implied by (leaves at indices) + (helper hashes): recompute
+    every path node bottom-up. A parent is derived the moment both its
+    children are known; processing order (descending start keys, derived
+    parents appended) guarantees each derivation fires exactly once."""
+    from ..utils.hash import hash_eth2
+
+    _check_independent(indices)
+    helper_indices = get_helper_indices(indices)
+    if len(leaves) != len(indices):
+        raise ValueError("leaves/indices length mismatch")
+    if len(proof) != len(helper_indices):
+        raise ValueError("proof length does not match helper set")
+    objects = {
+        **{index: bytes(node) for index, node in zip(indices, leaves)},
+        **{index: bytes(node) for index, node in zip(helper_indices, proof)},
+    }
+    keys = sorted(objects.keys(), reverse=True)
+    pos = 0
+    while pos < len(keys):
+        k = keys[pos]
+        if k in objects and k ^ 1 in objects and k // 2 not in objects:
+            objects[k // 2] = hash_eth2(objects[k & ~1] + objects[k | 1])
+            keys.append(k // 2)
+        pos += 1
+    if 1 not in objects:
+        raise ValueError("multiproof does not resolve to a root")
+    return objects[1]
+
+
+def verify_multiproof(leaves, proof, indices, root: bytes) -> bool:
+    try:
+        return calculate_multi_merkle_root(leaves, proof, indices) == bytes(root)
+    except ValueError:
+        return False

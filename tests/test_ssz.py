@@ -401,3 +401,64 @@ def test_sequence_bulk_numpy_roundtrip():
     # uint8 participation-flag shape
     part = List[uint8, 64].from_values([0, 1, 3, 7])
     assert part.to_numpy().dtype == np.uint8
+
+
+def test_multiproof_roundtrip_beacon_state_fields():
+    """Multiproof over several BeaconState leaves verifies against the
+    state root, and the single-index case degenerates to build_proof."""
+    import consensus_specs_tpu.ssz as ssz
+    from consensus_specs_tpu.compiler import get_spec
+    from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
+    from consensus_specs_tpu.crypto import bls
+
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        spec = get_spec("altair", "minimal")
+        state = create_valid_beacon_state(spec)
+    finally:
+        bls.bls_active = was
+    root = bytes(ssz.hash_tree_root(state))
+
+    g_fin = ssz.get_generalized_index(type(state), "finalized_checkpoint")
+    g_slot = ssz.get_generalized_index(type(state), "slot")
+    g_fork = ssz.get_generalized_index(type(state), "fork")
+    indices = [g_fin, g_slot, g_fork]
+    leaves = [
+        bytes(ssz.hash_tree_root(state.finalized_checkpoint)),
+        bytes(ssz.hash_tree_root(state.slot)),
+        bytes(ssz.hash_tree_root(state.fork)),
+    ]
+    proof = ssz.build_multiproof(state, indices)
+    assert ssz.verify_multiproof(leaves, proof, indices, root)
+    # helper set is minimal: shorter than the three separate branches
+    assert len(proof) < sum(len(ssz.build_proof(state, g)) for g in indices)
+    # tampered leaf fails
+    bad = list(leaves)
+    bad[1] = b"\x66" * 32
+    assert not ssz.verify_multiproof(bad, proof, indices, root)
+    # single-index multiproof == the classic branch (deepest-first)
+    assert ssz.build_multiproof(state, [g_fin]) == ssz.build_proof(state, g_fin)
+
+
+def test_multiproof_degenerate_and_invalid_sets():
+    import pytest as _pytest
+
+    import consensus_specs_tpu.ssz as ssz
+    from consensus_specs_tpu.utils.hash import hash_eth2
+
+    # root proves itself with an empty helper set
+    leaf = b"\x17" * 32
+    assert ssz.get_helper_indices([1]) == []
+    assert ssz.verify_multiproof([leaf], [], [1], leaf)
+    # sibling leaves: each is the other's helper -> empty helper set
+    left, right = b"\x01" * 32, b"\x02" * 32
+    root = hash_eth2(left + right)
+    assert ssz.get_helper_indices([2, 3]) == []
+    assert ssz.verify_multiproof([left, right], [], [2, 3], root)
+    # ancestor-of-leaf sets are rejected, not deduplicated
+    with _pytest.raises(ValueError):
+        ssz.build_multiproof(None, [2, 4])  # 2 is 4's parent (checked first)
+    assert not ssz.verify_multiproof([leaf, leaf], [], [2, 4], root)
+    # wrong proof length rejected
+    assert not ssz.verify_multiproof([left, right], [leaf], [2, 3], root)
