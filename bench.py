@@ -5,9 +5,12 @@
    e(pk_i, H(m_i))·e(-G1, sig_i) == 1 checks through the RNS pairing kernels
    (ops/bls12_jax.py over ops/fp_rns.py). Target >= 100k/s (BASELINE.json);
    `vs_baseline` is measured/target.
-2. `extra.process_epoch_1m_s`: mainnet-preset 1M-validator altair
-   `process_epoch` device wall-clock (target < 2 s;
-   `extra.epoch_vs_baseline` = 2.0/measured).
+2. `extra.process_epoch_s` (+ `extra.epoch_validators` for the size it ran
+   at): mainnet-preset altair `process_epoch` device wall-clock (target
+   < 2 s at 1M validators; the `process_epoch_1m_s` alias is emitted only
+   when the run really is >=1M). `extra.epoch_vs_baseline` = 2.0/measured,
+   emitted only for unclamped accelerator runs — the cpu-debug lane
+   carries NO `*_vs_baseline` ratios.
 
 The reference publishes no numbers (BASELINE.json `published: {}`), so both
 baselines are the BASELINE.json targets. Host prep (decompression,
@@ -187,7 +190,10 @@ def run_benches() -> dict:
             "bls_batch": N_BLS,
             "bls_verify_throughput_rlc": round(rlc_vps, 1),
             "bls_compile_s": round(compile_s, 1),
-            "process_epoch_1m_s": round(epoch_s, 4),
+            # keyed by the ACTUAL registry size measured — the 1M alias is
+            # added only when the run really is 1M (VERDICT r4 weak #3)
+            "process_epoch_s": round(epoch_s, 4),
+            "epoch_validators": N_VALIDATORS,
             "epoch_vs_baseline": round(EPOCH_TARGET_S / epoch_s, 2),
             # cold = caches cleared (comparable with r1-r3 recordings);
             # warm = marginal re-verification rate with caches hot
@@ -275,10 +281,18 @@ def main() -> None:
         os.environ.setdefault("BENCH_KZG_BLOBS", "16")
     try:
         record = run_benches()
+        if N_VALIDATORS >= 1_048_576:
+            record["extra"]["process_epoch_1m_s"] = record["extra"]["process_epoch_s"]
         if cpu_debug:
+            # Honest debug scoreboard (VERDICT r4 weak #3): a clamped-shape
+            # CPU run carries NO baseline ratios — the targets are defined
+            # on TPU at full shapes, so any ratio computed here is noise
+            # that reads as target-beaten.
             record["error"] = "tpu_unavailable"
             record["extra"]["mode"] = "cpu_debug_small_shapes"
             record["vs_baseline"] = 0.0
+            for k in [k for k in record["extra"] if k.endswith("_vs_baseline")]:
+                del record["extra"][k]
     except Exception as exc:  # scoreboard line must parse no matter what
         import traceback
 

@@ -73,9 +73,12 @@ PREVIOUS_FORK = {
     "custody_game": "sharding",
 }
 
-# Two+ chars: single-letter table rows (gossipsub tuning parameters like
-# `D` in the p2p docs) are protocol documentation, not spec constants.
+# Constant-table cell names. Single-letter rows (gossipsub tuning
+# parameters like `D`) are protocol documentation, not spec constants —
+# but ONLY in the p2p documents; everywhere else a single-letter ALL-CAPS
+# name is a legitimate constant (parse_spec_markdown takes the flag).
 _CONST_RE = re.compile(r"^[A-Z][A-Z0-9_]+$")
+_CONST_RE_1CHAR = re.compile(r"^[A-Z][A-Z0-9_]*$")
 _SKIP_DIRECTIVE = "<!-- spec: skip -->"
 
 
@@ -94,7 +97,11 @@ def _parse_table_value(text: str):
         return None
 
 
-def parse_spec_markdown(text: str) -> SpecDoc:
+def parse_spec_markdown(text: str, allow_single_letter_constants: bool = False) -> SpecDoc:
+    # Strict (two+ chars) by default so legacy callers (tools/typegate.py)
+    # see exactly the constant set build_spec compiles; build_spec opts
+    # non-p2p documents into single-letter names.
+    const_re = _CONST_RE_1CHAR if allow_single_letter_constants else _CONST_RE
     doc = SpecDoc()
     lines = text.split("\n")
     i = 0
@@ -125,7 +132,7 @@ def parse_spec_markdown(text: str) -> SpecDoc:
             continue
         if line.lstrip().startswith("|"):
             cells = [c.strip() for c in line.strip().strip("|").split("|")]
-            if len(cells) >= 2 and _CONST_RE.match(cells[0]):
+            if len(cells) >= 2 and const_re.match(cells[0]):
                 value = _parse_table_value(cells[1])
                 if value is not None:
                     doc.constants[cells[0]] = value
@@ -267,7 +274,9 @@ def build_spec(fork: str, preset_name: str, config_overrides: dict | None = None
             full = SPEC_DIR / doc_path
             if not full.exists():
                 continue
-            doc = parse_spec_markdown(full.read_text())
+            doc = parse_spec_markdown(
+                full.read_text(), allow_single_letter_constants="p2p" not in doc_path
+            )
             docs.append(doc)
             all_constants.update(doc.constants)
 

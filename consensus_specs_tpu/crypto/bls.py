@@ -222,7 +222,29 @@ def Sign(privkey, message) -> bytes:
     # (privkey, root) pairs constantly (cached genesis states, randao
     # reveals over the same epochs, selection proofs), and each pure-Python
     # G2 scalar mul costs ~10 ms. ~200 B/entry -> 2^16 cap < ~15 MB.
+    # TEST-VECTOR INTENT ONLY: the cache pins raw private keys in process
+    # memory for the process lifetime — fine for the deterministic test
+    # keys 1..8192, unacceptable for real secrets. Call clear_sign_cache()
+    # (or bls.clear_caches()) to drop them.
     return _sign_lru(int(privkey), bytes(message))
+
+
+def clear_sign_cache() -> None:
+    """Drop the Sign memo (pins privkeys; see Sign docstring)."""
+    _sign_lru.cache_clear()
+
+
+def clear_caches() -> None:
+    """Drop every host-side crypto cache: the Sign memo plus the jax
+    backend's committee-aggregate LRU and point-decode/hash-to-curve
+    lru_caches (g1_from_bytes alone can hold ~0.5 GB at its default size)."""
+    clear_sign_cache()
+    from . import bls_jax
+
+    bls_jax._AGG_CACHE.clear()
+    bls_jax.g1_from_bytes.cache_clear()
+    bls_jax.g2_from_bytes.cache_clear()
+    bls_jax.hash_to_curve_g2.cache_clear()
 
 
 from functools import lru_cache as _lru_cache

@@ -144,7 +144,12 @@ def _aggregate_pubkeys_affine(pubkeys_bytes: list):
 
     key = hashlib.sha256(b"".join(pubkeys_bytes)).digest()
     if key in _AGG_CACHE:
-        return _AGG_CACHE[key]
+        # LRU, not FIFO: refresh the hit so a hot committee aggregate
+        # inserted early outlives cold entries (dict preserves insertion
+        # order; re-inserting moves it to the end, i.e. most-recent).
+        agg = _AGG_CACHE.pop(key)
+        _AGG_CACHE[key] = agg
+        return agg
     acc = None
     for pk in pubkeys_bytes:
         aff = g1_from_bytes(pk)

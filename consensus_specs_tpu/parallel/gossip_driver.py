@@ -58,7 +58,12 @@ def message_id_v2(topic: bytes, data: bytes) -> bytes:
     try:
         payload = decompress(data)
         domain = MESSAGE_DOMAIN_VALID_SNAPPY
-    except Exception:
+    except (ValueError, IndexError):
+        # The wire-format failures snappy.decompress raises (ValueError from
+        # the native path, IndexError from the pure-Python fallback on
+        # truncated input); anything else (MemoryError, a broken native
+        # import) must propagate — it is an environment fault, not an
+        # invalid message.
         payload = data
         domain = MESSAGE_DOMAIN_INVALID_SNAPPY
     return hashlib.sha256(domain + prefix + payload).digest()[:20]
