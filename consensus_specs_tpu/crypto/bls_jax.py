@@ -143,13 +143,14 @@ def _aggregate_pubkeys_affine(pubkeys_bytes: list):
     import hashlib
 
     key = hashlib.sha256(b"".join(pubkeys_bytes)).digest()
-    if key in _AGG_CACHE:
-        # LRU, not FIFO: refresh the hit so a hot committee aggregate
-        # inserted early outlives cold entries (dict preserves insertion
-        # order; re-inserting moves it to the end, i.e. most-recent).
-        agg = _AGG_CACHE.pop(key)
-        _AGG_CACHE[key] = agg
-        return agg
+    # LRU, not FIFO: refresh a hit so a hot committee aggregate inserted
+    # early outlives cold entries (re-insertion moves it to the dict's
+    # end). pop(key, None) keeps this race-safe against a concurrent hit
+    # or clear_caches() — a lost entry just recomputes below.
+    hit = _AGG_CACHE.pop(key, None)
+    if hit is not None:
+        _AGG_CACHE[key] = hit
+        return hit
     acc = None
     for pk in pubkeys_bytes:
         aff = g1_from_bytes(pk)

@@ -62,6 +62,14 @@ def compress(data: bytes) -> bytes:
     return out.raw[:n]
 
 
+# Upper bound on a DECLARED uncompressed length before any allocation:
+# the preamble varint is attacker-controlled wire data (gossip payloads),
+# and allocating what it claims would let a ~10-byte message demand
+# gigabytes. Far above every legitimate payload (MAX_CHUNK_SIZE /
+# GOSSIP_MAX_SIZE are 2^20; vector files are low MB).
+MAX_UNCOMPRESSED_LEN = 1 << 30
+
+
 def decompress(data: bytes) -> bytes:
     lib = _load()
     if lib is None:
@@ -69,6 +77,8 @@ def decompress(data: bytes) -> bytes:
     size = lib.snappy_tpu_uncompressed_length(data, len(data))
     if size < 0:
         raise ValueError("snappy: bad length preamble")
+    if size > MAX_UNCOMPRESSED_LEN:
+        raise ValueError("snappy: declared length exceeds limit")
     out = ctypes.create_string_buffer(max(size, 1))
     n = lib.snappy_tpu_decompress(data, len(data), out, size)
     if n != size:
@@ -142,6 +152,8 @@ def _py_decompress(data: bytes) -> bytes:
         if not b & 0x80:
             break
         shift += 7
+    if size > MAX_UNCOMPRESSED_LEN:
+        raise ValueError("snappy: declared length exceeds limit")
     out = bytearray()
     while ip < len(data):
         tag = data[ip]

@@ -59,7 +59,11 @@ def combined_source(fork: str) -> tuple[str, dict]:
             full = SPEC_DIR / doc_path
             if not full.exists():
                 continue
-            doc = parse_spec_markdown(full.read_text())
+            # same per-doc constant policy as build_spec (single-letter
+            # names are real constants outside the p2p docs)
+            doc = parse_spec_markdown(
+                full.read_text(), allow_single_letter_constants="p2p" not in doc_path
+            )
             constants.update(doc.constants)
             parts.extend(doc.python_blocks)
     return "\n\n".join(parts), constants
