@@ -59,18 +59,30 @@ FORK_DOCS = {
         "sharding/beacon-chain.md",
         "sharding/p2p-interface.md",
     ],
+    # das overlays sharding (its sampling operates on sharding's blobs and
+    # KZG commitments; reference das-core.md:90-186 carries 12 executable
+    # functions which compile here like any other spec document).
+    # sampling.md / fork-choice.md stay prose-only: the former has no code,
+    # the latter's blocks reference BeaconState fields no compiled fork
+    # defines (grandparent_epoch_confirmed_commitments — R&D sketch in the
+    # reference too).
+    "das": [
+        "das/das-core.md",
+        "das/p2p-interface.md",
+    ],
     "custody_game": [
         "custody_game/beacon-chain.md",
         "custody_game/validator.md",
     ],
 }
-FORK_ORDER = ["phase0", "altair", "bellatrix", "sharding", "custody_game"]
+FORK_ORDER = ["phase0", "altair", "bellatrix", "sharding", "das", "custody_game"]
 PREVIOUS_FORK = {
     "phase0": None,
     "altair": "phase0",
     "bellatrix": "altair",
     "sharding": "bellatrix",
-    "custody_game": "sharding",
+    "das": "sharding",
+    "custody_game": "das",
 }
 
 # Constant-table cell names. Single-letter rows (gossipsub tuning
@@ -198,6 +210,7 @@ def _runtime_namespace() -> dict:
     from .. import ssz
     from ..crypto import bls, kzg_shim
     from ..crypto import custody as custody_crypto
+    from ..crypto import das as das_kernels
     from ..utils.hash import hash_eth2
 
     ns: dict = {
@@ -221,7 +234,7 @@ def _runtime_namespace() -> dict:
         "get_merkle_proof": ssz.get_merkle_proof,
         # crypto
         "bls": bls, "hash": hash_eth2, "kzg": kzg_shim,
-        "custody_crypto": custody_crypto,
+        "custody_crypto": custody_crypto, "das_kernels": das_kernels,
         # python runtime
         "dataclass": dataclass, "field": field, "deepcopy": _pycopy.deepcopy,
         "Any": Any, "Callable": Callable, "Dict": Dict, "Optional": Optional,

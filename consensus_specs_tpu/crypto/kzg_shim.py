@@ -88,3 +88,49 @@ def prove_degree_bound_bytes(points: list[int], points_count: int) -> bytes:
         return identity_commitment()
     proof = kzg.prove_degree_bound(get_setup(), [p % kzg.MODULUS for p in points], points_count)
     return g1_to_bytes(pt_to_affine(FP_FIELD, proof))
+
+
+# --- das spec surface (specs/das/das-core.md) -------------------------------
+
+
+def check_multi_kzg_proof(commitment: bytes, proof: bytes, x: int, ys: list) -> bool:
+    """One multiproof check: does `proof` complement evaluations `ys` on the
+    coset x·H (H the len(ys)-element subgroup) to match `commitment`?
+    (reference specs/das/das-core.md:131-137, left `...` there; executable
+    here via crypto/kzg.verify_coset). Compressed inputs arrive from the
+    network — decompression failures are rejections."""
+    if not bls.bls_active:
+        return True
+    try:
+        c = pt_from_affine(FP_FIELD, g1_from_bytes(bytes(commitment)))
+        p = pt_from_affine(FP_FIELD, g1_from_bytes(bytes(proof)))
+    except ValueError:
+        return False
+    return kzg.verify_coset(
+        get_setup(), c, int(x) % kzg.MODULUS,
+        [int(y) % kzg.MODULUS for y in ys], p,
+    )
+
+
+def construct_proofs_bytes(poly_coeffs: list, points_per_sample: int) -> list:
+    """Multiproofs for every aligned coset of the extended polynomial,
+    indexed by DOMAIN position p (the coset w_{n2}^p · H). The reference
+    stubs this as FK20 (das-core.md:138-146); per-coset quotient proofs are
+    functionally equivalent (FK20 batch proving is a planned kernel)."""
+    n2 = len(poly_coeffs)
+    sample_count = n2 // points_per_sample
+    if not bls.bls_active:
+        return [b"\xc0" + b"\x00" * 47] * sample_count
+    coeffs = [int(c) % kzg.MODULUS for c in poly_coeffs]
+    # extended-data polynomial: degree < n, top half must be zero
+    assert all(c == 0 for c in coeffs[n2 // 2:]), "not an extension polynomial"
+    coeffs = coeffs[: n2 // 2]
+    from ..ops.fr_jax import root_of_unity
+
+    w = root_of_unity(n2)
+    setup = get_setup()
+    out = []
+    for p in range(sample_count):
+        proof, _ = kzg.prove_coset(setup, coeffs, pow(w, p, kzg.MODULUS), points_per_sample)
+        out.append(g1_to_bytes(pt_to_affine(FP_FIELD, proof)))
+    return out

@@ -13,7 +13,7 @@ from __future__ import annotations
 from ..compiler.spec_compiler import FORK_ORDER, PREVIOUS_FORK, get_spec
 
 STABLE_FORKS = ("phase0", "altair", "bellatrix")
-RND_FORKS = ("sharding", "custody_game")
+RND_FORKS = ("sharding", "das", "custody_game")
 
 UPGRADE_FN = {
     "altair": "upgrade_to_altair",

@@ -43,6 +43,7 @@ PHASE0 = "phase0"
 ALTAIR = "altair"
 BELLATRIX = "bellatrix"
 SHARDING = "sharding"
+DAS = "das"
 CUSTODY_GAME = "custody_game"
 # ALL_PHASES stays the stable fork set (the reference's with_all_phases
 # universe); sharding-era forks compile here (unlike the reference) but opt
