@@ -213,6 +213,7 @@ def run_benches() -> dict:
             "epoch_resident_s": e2e["resident_epoch_s"],
             "epoch_resident_scan_s": e2e["resident_scan_epoch_s"],
             "epoch_resident_state_root_s": e2e["resident_state_root_s"],
+            "epoch_resident_state_root_slot_s": e2e["resident_state_root_slot_s"],
             "epoch_resident_amortized_s": e2e["resident_amortized_epoch_s"],
             "epoch_resident_epochs": e2e["resident_epochs"],
             "epoch_resident_vs_baseline": round(

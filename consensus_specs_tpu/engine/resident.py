@@ -104,22 +104,31 @@ class ResidentEpochEngine:
         self._pre_cols = cols
         self._pre_mixes = np.asarray(dev.randao_mixes)
         self._step = resident_step_fn_for(cfg)
+        self._inc = None  # incremental root cache, built on first state_root()
+        self._pending_epochs = 0  # epoch refreshes owed to the cache
 
-    def step_epoch(self) -> None:
+    def step_epoch(self, advance_slots: bool = True) -> None:
         """One epoch transition; host work is O(1) except on period
-        boundaries (see module docstring)."""
+        boundaries (see module docstring). `advance_slots=False` is the
+        per-slot drive mode's boundary step (advance_slot owns the +1)."""
         self.dev, aux = self._step(self.dev)
         self._service_segment(
             np.asarray(aux.eth1_votes_reset)[None],
             np.asarray(aux.historical_append)[None],
             np.asarray(aux.sync_committee_update)[None],
+            advance_slots=advance_slots,
         )
 
-    def _service_segment(self, eth1_resets, hist_appends, sync_updates) -> None:
+    def _service_segment(self, eth1_resets, hist_appends, sync_updates,
+                         advance_slots: bool = True) -> None:
         """Host epilogues + slot-mirror advance for a segment of epochs,
         given the (seg,) aux flag arrays. Shared by step_epoch (seg=1) and
         run_epochs — the deferral-correctness argument lives on run_epochs."""
         seg = len(eth1_resets)
+        if not advance_slots:
+            # per-slot mode: the mirror sits at the epoch's LAST slot and
+            # advance_slot increments it after this returns
+            assert seg == 1
         if eth1_resets.any():
             self.state.eth1_data_votes = type(self.state.eth1_data_votes)()
         if hist_appends.any():
@@ -129,13 +138,28 @@ class ResidentEpochEngine:
                 self.state.historical_roots.append(self.spec.Root(root))
         if sync_updates.any():
             # segment slicing guarantees the rotation fires only at the
-            # segment's LAST epoch, so device columns are current for it
+            # segment's LAST epoch, so device columns are current for it.
+            # In both modes the mirror sits at the last slot of the epoch
+            # preceding the rotation when _rotate runs (its next_epoch =
+            # slot//SPE + 1 = the epoch being entered).
             assert sync_updates[-1] and int(sync_updates.sum()) == 1
-            self.state.slot += self.spec.SLOTS_PER_EPOCH * (seg - 1)
+            if advance_slots:
+                self.state.slot += self.spec.SLOTS_PER_EPOCH * (seg - 1)
             self._rotate_sync_committees_resident()
-            self.state.slot += self.spec.SLOTS_PER_EPOCH
-        else:
+            if advance_slots:
+                self.state.slot += self.spec.SLOTS_PER_EPOCH
+        elif advance_slots:
             self.state.slot += self.spec.SLOTS_PER_EPOCH * seg
+        # root-cache refreshes are LAZY: state_root() drains the owed epochs
+        # so steps stay pure for callers that never ask for roots. Segments
+        # are contiguous, so (last stepped epoch, count) identifies every
+        # touched randao/slashings row — the epoch is pinned HERE, as "the
+        # epoch just entered": post-advance slot//SPE, or (slot+1)//SPE when
+        # advance_slot still owes the +1.
+        self._pending_epochs += seg
+        slot = int(self.state.slot)
+        self._pending_last_epoch = (
+            slot if advance_slots else slot + 1) // self.cfg.slots_per_epoch
 
     def run_epochs(self, k: int) -> None:
         """k epoch transitions in as few device launches as possible.
@@ -218,20 +242,71 @@ class ResidentEpochEngine:
     def state_root(self) -> bytes:
         """hash_tree_root(BeaconState) WITHOUT materializing.
 
-        The registry-scale subtrees (validators, balances, participation,
-        inactivity, the root vectors and checkpoints) merkleize on device
-        in one jitted launch (engine/state_root.py); only their 32-byte
-        roots cross to the host, where they merge with the host-owned
-        field roots (genesis data, eth1, historical accumulator, sync
-        committees — all kept current by the step epilogues). Bit-equal
-        to materialize()+hash_tree_root (tests/test_resident_engine.py)."""
-        from .state_root import (
-            assemble_state_root,
-            state_root_fn,
-            validator_static_leaves,
-        )
+        INCREMENTAL (engine/incremental_root.py): the first call builds the
+        device-resident Merkle level arrays (cost ≈ one full device sweep);
+        every epoch step afterwards refreshes only what the transition
+        dirtied — the wholesale vectors rebuild, the validator registry
+        updates by dirty row, randao/slashings by path — and per-slot root
+        obligations (record_slot_root) cost one tree path each. Only the
+        32-byte field roots cross to the host, where they merge with the
+        host-owned field roots (genesis data, eth1, historical accumulator,
+        sync committees — all kept current by the step epilogues).
+        Bit-equal to materialize()+hash_tree_root
+        (tests/test_resident_engine.py)."""
+        from .incremental_root import IncrementalStateRoot
+        from .state_root import assemble_state_root, validator_static_leaves
 
-        if not hasattr(self, "_static_leaves"):
-            self._static_leaves = jnp.asarray(validator_static_leaves(self.state))
-        roots = state_root_fn()(self.dev, self._static_leaves)
-        return assemble_state_root(self.spec, self.state, jax.device_get(roots))
+        if self._inc is None:
+            if not hasattr(self, "_static_leaves"):
+                self._static_leaves = jnp.asarray(validator_static_leaves(self.state))
+            self._inc = IncrementalStateRoot(self.dev, self._static_leaves)
+        elif self._pending_epochs:
+            self._inc.refresh_after_epochs(
+                self.dev,
+                last_epoch=self._pending_last_epoch,
+                count=self._pending_epochs,
+                epochs_per_historical_vector=self.cfg.epochs_per_historical_vector,
+            )
+        self._pending_epochs = 0
+        roots = jax.device_get(self._inc.device_roots(int(self.state.slot)))
+        return assemble_state_root(self.spec, self.state, roots)
+
+    def advance_slot(self) -> None:
+        """`process_slot` (+ the epoch transition at boundaries) against the
+        resident state — the per-slot drive mode, exactly
+        specs/phase0/beacon-chain.md process_slots' loop body:
+
+          1. previous_state_root = hash_tree_root(state)   (incremental)
+          2. state_roots[slot % SPHR] = previous_state_root; fill an empty
+             latest_block_header.state_root; block_roots[slot % SPHR] =
+             hash_tree_root(latest_block_header)
+          3. at (slot+1) % SLOTS_PER_EPOCH == 0: process_epoch (the device
+             step, slot mirror untouched)
+          4. slot += 1
+
+        History-vector writes land on the host state (canonical), the
+        device arrays (the historical-batch epilogue reads them), and the
+        incremental root trees (one path each). Interleaves safely with
+        step_epoch()/run_epochs() — slot accounting is owned here in this
+        mode (step_epoch(advance_slots=False))."""
+        spec, state, cfg = self.spec, self.state, self.cfg
+        prev_root = self.state_root()
+        idx = int(state.slot) % cfg.slots_per_historical_root
+        root_words = jnp.asarray(np.frombuffer(prev_root, dtype=">u4").astype(np.uint32))
+        state.state_roots[idx] = spec.Root(prev_root)
+        self.dev = self.dev.replace(
+            state_roots=self.dev.state_roots.at[idx].set(root_words))
+        self._inc.record_state_root(idx, root_words)
+        if state.latest_block_header.state_root == spec.Root():
+            state.latest_block_header.state_root = spec.Root(prev_root)
+        from ..ssz import hash_tree_root as _htr
+
+        block_root = bytes(_htr(state.latest_block_header))
+        b_words = jnp.asarray(np.frombuffer(block_root, dtype=">u4").astype(np.uint32))
+        state.block_roots[idx] = spec.Root(block_root)
+        self.dev = self.dev.replace(
+            block_roots=self.dev.block_roots.at[idx].set(b_words))
+        self._inc.record_block_root(idx, b_words)
+        if (int(state.slot) + 1) % cfg.slots_per_epoch == 0:
+            self.step_epoch(advance_slots=False)
+        state.slot += 1

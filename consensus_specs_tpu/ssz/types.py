@@ -216,6 +216,17 @@ class SSZType:
     def decode_bytes(cls, data: bytes):
         raise NotImplementedError
 
+    def __deepcopy__(self, memo):
+        """Route copy.deepcopy through .copy(): the default deepcopy would
+        clone `_parents` weakref entries (which deepcopy atomically, still
+        pointing at the ORIGINAL ancestors) together with the cached merkle
+        state — so a mutation on the copy would invalidate the original's
+        caches and leave the copy's root STALE. .copy() rebuilds the
+        parent links and clones the merkle state correctly."""
+        new = self.copy()
+        memo[id(self)] = new
+        return new
+
 
 def _pack_bytes_to_chunks(data: bytes) -> list[bytes]:
     """Right-pad to a chunk multiple and split (spec `pack`)."""

@@ -162,3 +162,49 @@ def test_run_epochs_scan_matches_stepwise(spec, k_epochs):
         assert bytes(hash_tree_root(st_a)) == bytes(hash_tree_root(st_b))
     finally:
         bls.bls_active = was
+
+
+def test_resident_per_slot_roots_incremental(spec):
+    """process_slot's per-slot obligation against the resident state
+    (engine/incremental_root.py): advance_slot() records state and header
+    roots one tree path at a time — including across an epoch boundary,
+    where it fires the device epoch step itself — and stays bit-equal to
+    the host SSZ tree. Differential oracle: the compiled spec's
+    process_slots over the materialized state."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = _prepared_state(spec, start_epoch=6, seed=11)
+        import copy as _copy
+
+        oracle = _copy.deepcopy(st)
+        eng = ResidentEpochEngine(spec, st)
+        n_slots = int(spec.SLOTS_PER_EPOCH) + 5  # crosses one boundary
+        for _ in range(n_slots):
+            eng.advance_slot()
+        inc_root = eng.state_root()
+        eng.materialize()
+        assert inc_root == bytes(hash_tree_root(st))
+        # spec-level oracle: identical end state via process_slots
+        spec.process_slots(oracle, oracle.slot + n_slots)
+        assert bytes(hash_tree_root(oracle)) == inc_root
+    finally:
+        bls.bls_active = was
+
+
+def test_resident_incremental_across_scan_segments(spec):
+    """run_epochs (scan form) refreshes the incremental cache per segment:
+    roots after multi-epoch scans equal the host tree, including across a
+    sync-committee rotation boundary."""
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = _prepared_state(spec, start_epoch=6, seed=12)
+        eng = ResidentEpochEngine(spec, st)
+        eng.state_root()  # build the cache BEFORE any step: scan path must refresh it
+        eng.run_epochs(5)
+        inc_root = eng.state_root()
+        eng.materialize()
+        assert inc_root == bytes(hash_tree_root(st))
+    finally:
+        bls.bls_active = was
