@@ -89,7 +89,7 @@ bench:
 # Target <5 min warm so every perf commit can re-prove itself on TPU.
 bench_quick:
 	BENCH_BLS_N=512 BENCH_E2E_RESIDENT_EPOCHS=6 BENCH_KZG_BLOBS=32 \
-	BENCH_ATT_VALIDATORS=8192 BENCH_SR_VALIDATORS=262144 \
+	BENCH_ATT_VALIDATORS=32768 BENCH_SR_VALIDATORS=262144 \
 	BENCH_E2E_VALIDATORS=1048576 $(PYTHON) bench.py
 
 # What the driver compile-checks: single-chip entry + 8-device CPU-mesh dry

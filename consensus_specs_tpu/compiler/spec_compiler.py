@@ -262,7 +262,7 @@ def _accelerated_shuffle(seed: bytes, index_count: int, rounds: int):
 
     if index_count == 0 or "jax" not in sys.modules:
         return None
-    if os.environ.get("CONSENSUS_TPU_HOST_SHUFFLE"):
+    if os.environ.get("CONSENSUS_TPU_HOST_SHUFFLE", "").lower() in ("1", "true", "yes"):
         return None
     try:
         from ..ops.shuffle import compute_shuffled_indices

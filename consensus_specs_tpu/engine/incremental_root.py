@@ -37,13 +37,9 @@ from ..ops.sha256_jax import merkle_parent_level, sha256_64B_words
 from .state_root import (
     DEPTH_VALIDATORS,
     _bswap32,
-    _checkpoint_root,
     _extend,
-    _list_root_u64,
-    _list_root_u8,
     _mix_len,
     _u64_chunk_words,
-    _u8_chunk_words,
 )
 
 U32 = jnp.uint32
@@ -186,27 +182,11 @@ def _full_validators_build_fn():
 
 @lru_cache(maxsize=None)
 def _wholesale_roots_fn():
-    """Roots of the fields an epoch rewrites wholesale + the O(1) fields."""
+    """Roots of the fields an epoch rewrites wholesale + the O(1) fields
+    (single source: state_root.light_field_roots)."""
+    from .state_root import light_field_roots
 
-    def roots(st):
-        bits = st.justification_bits.astype(jnp.uint8)
-        weights = jnp.asarray(np.array([1, 2, 4, 8], dtype=np.uint8))
-        jb_byte = jnp.sum(bits * weights).astype(jnp.uint8)
-        return {
-            "balances": _list_root_u64(st.balances),
-            "inactivity_scores": _list_root_u64(st.inactivity_scores),
-            "previous_epoch_participation": _list_root_u8(st.prev_participation),
-            "current_epoch_participation": _list_root_u8(st.curr_participation),
-            "justification_bits": _u8_chunk_words(jb_byte[None])[0],
-            "previous_justified_checkpoint": _checkpoint_root(
-                st.prev_justified_epoch, st.prev_justified_root),
-            "current_justified_checkpoint": _checkpoint_root(
-                st.curr_justified_epoch, st.curr_justified_root),
-            "finalized_checkpoint": _checkpoint_root(
-                st.finalized_epoch, st.finalized_root),
-        }
-
-    return jax.jit(roots)
+    return jax.jit(light_field_roots)
 
 
 @lru_cache(maxsize=None)
@@ -255,8 +235,9 @@ def _root_of(levels: tuple) -> jax.Array:
 class IncrementalStateRoot:
     """HBM-resident Merkle state for every registry-scale BeaconState field.
 
-    Owned by ResidentEpochEngine; `refresh_after_epoch` follows each epoch
-    step, `record_slot_root` follows each per-slot root write, and
+    Owned by ResidentEpochEngine; `refresh_after_epochs` follows each run
+    of epoch steps, `record_state_root`/`record_block_root` follow each
+    per-slot root write (the engine's advance_slot drives them), and
     `device_roots()` yields the field-root dict `assemble_state_root`
     consumes. All cached arrays are COPIES — the engine's step donates its
     input pytree, so holding references into a donated state would read

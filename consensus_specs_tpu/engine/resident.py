@@ -247,7 +247,7 @@ class ResidentEpochEngine:
         every epoch step afterwards refreshes only what the transition
         dirtied — the wholesale vectors rebuild, the validator registry
         updates by dirty row, randao/slashings by path — and per-slot root
-        obligations (record_slot_root) cost one tree path each. Only the
+        obligations (advance_slot) cost one tree path each. Only the
         32-byte field roots cross to the host, where they merge with the
         host-owned field roots (genesis data, eth1, historical accumulator,
         sync committees — all kept current by the step epilogues).

@@ -182,34 +182,45 @@ def _checkpoint_root(epoch: jax.Array, root_words: jax.Array) -> jax.Array:
         jnp.concatenate([_u64_single_chunk(epoch), root_words])[None])[0]
 
 
+def light_field_roots(st) -> dict:
+    """Roots of the fields an epoch rewrites wholesale plus the O(1)
+    fields — the non-cacheable part of the device state root, shared by
+    the full sweep below and the incremental path
+    (engine/incremental_root.py). Traceable (call under jit)."""
+    bits = st.justification_bits.astype(jnp.uint8)
+    weights = jnp.asarray(np.array([1, 2, 4, 8], dtype=np.uint8))
+    jb_byte = jnp.sum(bits * weights).astype(jnp.uint8)
+    return {
+        "balances": _list_root_u64(st.balances),
+        "inactivity_scores": _list_root_u64(st.inactivity_scores),
+        "previous_epoch_participation": _list_root_u8(st.prev_participation),
+        "current_epoch_participation": _list_root_u8(st.curr_participation),
+        "justification_bits": _u8_chunk_words(jb_byte[None])[0],
+        "previous_justified_checkpoint": _checkpoint_root(
+            st.prev_justified_epoch, st.prev_justified_root),
+        "current_justified_checkpoint": _checkpoint_root(
+            st.curr_justified_epoch, st.curr_justified_root),
+        "finalized_checkpoint": _checkpoint_root(
+            st.finalized_epoch, st.finalized_root),
+    }
+
+
 def make_state_root_fn():
     """jit: (EpochState, static01) -> dict of device-owned field roots.
     jit itself specializes per input shape, so one module-level instance
     serves every (config, N)."""
 
     def field_roots(st, static01):
-        bits = st.justification_bits.astype(jnp.uint8)
-        weights = jnp.asarray(np.array([1, 2, 4, 8], dtype=np.uint8))
-        jb_byte = jnp.sum(bits * weights).astype(jnp.uint8)
-        return {
+        roots = light_field_roots(st)
+        roots.update({
             "slot": _u64_single_chunk(st.slot),
             "validators": _validators_root(static01, st),
-            "balances": _list_root_u64(st.balances),
-            "inactivity_scores": _list_root_u64(st.inactivity_scores),
-            "previous_epoch_participation": _list_root_u8(st.prev_participation),
-            "current_epoch_participation": _list_root_u8(st.curr_participation),
             "slashings": _vector_root_words(_u64_chunk_words(st.slashings)),
             "randao_mixes": _vector_root_words(st.randao_mixes),
             "block_roots": _vector_root_words(st.block_roots),
             "state_roots": _vector_root_words(st.state_roots),
-            "justification_bits": _u8_chunk_words(jb_byte[None])[0],
-            "previous_justified_checkpoint": _checkpoint_root(
-                st.prev_justified_epoch, st.prev_justified_root),
-            "current_justified_checkpoint": _checkpoint_root(
-                st.curr_justified_epoch, st.curr_justified_root),
-            "finalized_checkpoint": _checkpoint_root(
-                st.finalized_epoch, st.finalized_root),
-        }
+        })
+        return roots
 
     return jax.jit(field_roots)
 
