@@ -319,3 +319,14 @@ def get_spec(fork: str, preset_name: str) -> pytypes.ModuleType:
     if key not in _SPEC_CACHE:
         _SPEC_CACHE[key] = build_spec(fork, preset_name)
     return _SPEC_CACHE[key]
+
+
+def get_spec_with_overrides(fork: str, preset_name: str, overrides: dict) -> pytypes.ModuleType:
+    """Memoized build_spec for runtime-config overrides: the same override
+    set returns the SAME module object, so downstream per-module caches
+    (testlib genesis states, jit signatures keyed on spec classes) hit
+    instead of rebuilding a module + genesis per test invocation."""
+    key = (fork, preset_name, tuple(sorted(overrides.items())))
+    if key not in _SPEC_CACHE:
+        _SPEC_CACHE[key] = build_spec(fork, preset_name, config_overrides=overrides)
+    return _SPEC_CACHE[key]

@@ -352,9 +352,26 @@ def _replay_fork_choice(spec, case_dir, meta):
     anchor_block = _read_ssz(case_dir, "anchor_block", spec.BeaconBlock)
     store = spec.get_forkchoice_store(anchor_state, anchor_block)
     steps = _read_yaml(case_dir, "steps") or []
+    # merge-transition scenarios install a synthetic PoW view (`pow_block`
+    # steps); the spec's get_pow_block serves from it for this case only
+    pow_table: dict = {}
+    prev_get_pow = getattr(spec, "get_pow_block", None)
+    if prev_get_pow is not None:
+        spec.get_pow_block = lambda block_hash: pow_table.get(bytes(block_hash))
+    try:
+        _replay_fork_choice_steps(spec, case_dir, store, steps, pow_table)
+    finally:
+        if prev_get_pow is not None:
+            spec.get_pow_block = prev_get_pow
+
+
+def _replay_fork_choice_steps(spec, case_dir, store, steps, pow_table):
     for step in steps:
         if "tick" in step:
             spec.on_tick(store, int(step["tick"]))
+        elif "pow_block" in step:
+            pb = _read_ssz(case_dir, step["pow_block"], spec.PowBlock)
+            pow_table[bytes(pb.block_hash)] = pb
         elif "block" in step:
             block = _read_ssz(case_dir, step["block"], spec.SignedBeaconBlock)
             if step.get("valid", True):
@@ -525,7 +542,21 @@ def replay_case(case_dir: Path, preset: str, fork: str, runner: str, handler: st
         if runner == "ssz_generic":
             _replay_ssz_generic(case_dir, handler, suite, case_name or case_dir.name)
             return
-        spec = get_spec(fork, preset)
+        cfg_overrides = _read_yaml(case_dir, "config")
+        if cfg_overrides:
+            # the case was generated under modified runtime config
+            # (with_config_overrides emits config.yaml); replaying it
+            # against the default config is a different test entirely
+            from ..compiler.spec_compiler import get_spec_with_overrides
+
+            converted = {
+                k: bytes.fromhex(v[2:])
+                if isinstance(v, str) and v.startswith("0x") else v
+                for k, v in cfg_overrides.items()
+            }
+            spec = get_spec_with_overrides(fork, preset, converted)
+        else:
+            spec = get_spec(fork, preset)
         if runner == "operations":
             _replay_operations(spec, case_dir, meta)
         elif runner == "epoch_processing":

@@ -86,14 +86,15 @@ def _mesh_rlc_fn(mesh, p2_is_neg_g1: bool):
     """Mesh-sharded `pairing_check_rlc`: the flagship kernel's scale-out.
 
     Signature sets are sharded on the data axis; every device runs the
-    z-scalar ladders and BOTH Miller loops for its shard and tree-folds its
-    local Fp12 values (pure compute, no wire traffic). ONE `all_gather`
-    moves the n_devices Fp12 partials (~1.2 KB each) over ICI; the tail
-    product and the single shared final exponentiation run replicated, so
-    the returned bool is identical on every device. Communication volume is
-    independent of batch size — the Miller-loop FLOPs scale down 1/devices
-    while the final exp (the serial ~1/3 of the single-chip cost) is paid
-    once, not once per device shard.
+    z-scalar ladders and its shard's Miller loops, tree-folding local Fp12
+    values (pure compute, no wire traffic). With `p2_is_neg_g1` the second
+    pairing set collapses by bilinearity exactly as in the single-device
+    kernel (ops/bls12_jax.py): each shard ladders and locally sums
+    [z_i]·sig_i on G2, the per-device partial POINTS (~600 B each) ride
+    the same all_gather round as the Fp12 partials, and the one extra
+    Miller loop for e(−G1, Σ z_i·sig_i) runs replicated. Communication
+    volume stays independent of batch size; the final exponentiation is
+    paid once, not per shard.
     """
     import jax.numpy as jnp
     from jax import shard_map
@@ -106,15 +107,32 @@ def _mesh_rlc_fn(mesh, p2_is_neg_g1: bool):
         check_vma=False,  # replicated tail, same stance as the G1 reduce
     )
     def rlc_shards(qx, qy, px, py, q2x, q2y, p2x, p2y, zbits):
-        one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), px.shape).astype(px.dtype)
-        z1 = K.g1_scalar_mul_batch((px, py, one), zbits)
-        if p2_is_neg_g1:
-            z2 = K.g1_fixed_mul_neg_g1(zbits)
-        else:
-            z2 = K.g1_scalar_mul_batch((p2x, p2y, one), zbits)
-        a1x, a1y = K._g1_jacobian_to_affine_batch(z1)
-        a2x, a2y = K._g1_jacobian_to_affine_batch(z2)
+        a1x, a1y = K.rlc_randomize_g1(px, py, zbits)
         m1 = K.miller_loop_batch(qx, qy, a1x, a1y)
+        if p2_is_neg_g1:
+            one = jnp.broadcast_to(
+                jnp.asarray(K.F.ONE_MONT), q2x[0].shape).astype(q2x[0].dtype)
+            one2 = (one, jnp.zeros_like(one))
+            zsig = K.g2_scalar_mul_batch((q2x, q2y, one2), zbits)
+            local_pt = K.g2_sum_reduce(zsig)  # shard's Σ [z_i]·sig_i
+
+            def gather_f2(c):
+                return (
+                    jax.lax.all_gather(c[0][None], DATA_AXIS, axis=0, tiled=True),
+                    jax.lax.all_gather(c[1][None], DATA_AXIS, axis=0, tiled=True),
+                )
+
+            total_pt = K.g2_sum_reduce(tuple(gather_f2(c) for c in local_pt))
+            aqx, aqy = K.g2_jacobian_to_affine(total_pt)
+            ngx, ngy = K._neg_g1_affine_mont()
+            m2_single = K.miller_loop_batch(aqx, aqy, ngx, ngy)
+            local = K.f12_prod_reduce(m1)  # leading dim 1
+            gathered = jax.tree.map(
+                lambda c: jax.lax.all_gather(c, DATA_AXIS, axis=0, tiled=True), local)
+            return K.rlc_tail(gathered, m2_single)
+        one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), px.shape).astype(px.dtype)
+        z2 = K.g1_scalar_mul_batch((p2x, p2y, one), zbits)
+        a2x, a2y = K._g1_jacobian_to_affine_batch(z2)
         m2 = K.miller_loop_batch(q2x, q2y, a2x, a2y)
         local = K.f12_prod_reduce(K.f12_mul(m1, m2))  # leading dim 1
         gathered = jax.tree.map(

@@ -1,0 +1,181 @@
+"""Dual-mode bellatrix merge-transition fork choice: on_merge_block matrix.
+
+The transition block (the first block whose body carries a non-empty
+ExecutionPayload) is validated against the PoW chain inside on_block
+(specs/bellatrix/fork-choice.md validate_merge_block): its payload must
+build on a TERMINAL PoW block — total_difficulty >= TERMINAL_TOTAL_DIFFICULTY
+with a parent still below it — or, when TERMINAL_BLOCK_HASH is overridden,
+on exactly that hash at or after its activation epoch.
+
+Reference parity: test/bellatrix/fork_choice/test_on_merge_block.py
+(test_all_valid, test_block_lookup_failed, test_too_early_for_merge,
+test_too_late_for_merge) plus the TERMINAL_BLOCK_HASH override matrix the
+reference keeps in its validator/unit tests. Emitted vectors follow the
+fork_choice format with `pow_block` steps installing the synthetic PoW
+view (tests/formats/fork_choice).
+"""
+from ..testlib.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from ..testlib.context import (
+    BELLATRIX,
+    spec_state_test,
+    with_config_overrides,
+    with_phases,
+)
+from ..testlib.fork_choice import (
+    add_block_step,
+    add_checks_step,
+    add_pow_block_step,
+    finalize_steps,
+    initialize_steps,
+    tick_to_slot_step,
+)
+from ..testlib.pow_block import pow_chain, prepare_terminal_pow_chain
+
+TERMINAL_OVERRIDE = b"\x77" * 32
+
+
+def _make_pre_merge(spec, state):
+    """Reset the anchor to a pre-merge execution header (the transition has
+    not happened yet as far as this state is concerned)."""
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+
+
+def _signed_merge_block(spec, state, pow_parent_hash):
+    """A signed transition block whose payload builds on `pow_parent_hash`
+    (state is advanced + mutated exactly as the store's on_block will)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = spec.ExecutionPayload()
+    payload.parent_hash = spec.Hash32(pow_parent_hash)
+    payload.random = spec.get_randao_mix(state, spec.get_current_epoch(state))
+    payload.timestamp = spec.compute_timestamp_at_slot(state, block.slot)
+    payload.block_hash = spec.Hash32(b"\xcc" * 32)
+    payload.block_number = 1
+    block.body.execution_payload = payload
+    assert spec.is_merge_transition_block(state, block.body)
+    return state_transition_and_sign_block(spec, state.copy(), block)
+
+
+def _merge_scenario(spec, state, pow_blocks, payload_parent_hash, valid):
+    """Shared scenario body: install the PoW view, tick one slot, apply the
+    transition block, emit checks."""
+    _make_pre_merge(spec, state)
+    store, parts, steps = initialize_steps(spec, state)
+    for pb in pow_blocks:
+        add_pow_block_step(parts, steps, pb)
+    tick_to_slot_step(spec, store, steps, 1)
+    signed = _signed_merge_block(spec, state, payload_parent_hash)
+    with pow_chain(spec, pow_blocks):
+        root = add_block_step(spec, store, parts, steps, signed, valid=valid)
+    if valid:
+        assert root in store.blocks
+        head = add_checks_step(spec, store, steps)
+        assert head == root
+    yield from finalize_steps(parts, steps)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_on_merge_block_all_valid(spec, state):
+    """Payload parent is terminal (>= TTD, parent below): accepted and
+    becomes head."""
+    parent, terminal = prepare_terminal_pow_chain(spec)
+    yield from _merge_scenario(spec, state, [parent, terminal],
+                               terminal.block_hash, valid=True)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_on_merge_block_lookup_failed(spec, state):
+    """The terminal block's own parent is missing from the PoW view: the
+    ancestry check cannot complete and the block is rejected."""
+    _, terminal = prepare_terminal_pow_chain(spec)
+    yield from _merge_scenario(spec, state, [terminal],
+                               terminal.block_hash, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_on_merge_block_payload_parent_unknown(spec, state):
+    """The payload's parent hash itself resolves to nothing."""
+    parent, terminal = prepare_terminal_pow_chain(spec)
+    yield from _merge_scenario(spec, state, [parent, terminal],
+                               b"\x5e" * 32, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_on_merge_block_too_early_for_merge(spec, state):
+    """Whole PoW view still below terminal difficulty: the transition block
+    arrived before the merge is allowed."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    grandparent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x11" * 32),
+        parent_hash=spec.Hash32(b"\x00" * 32),
+        total_difficulty=spec.uint256(max(ttd - 2, 0)),
+    )
+    parent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x12" * 32),
+        parent_hash=grandparent.block_hash,
+        total_difficulty=spec.uint256(max(ttd - 1, 0)),
+    )
+    yield from _merge_scenario(spec, state, [grandparent, parent],
+                               parent.block_hash, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_on_merge_block_too_late_for_merge(spec, state):
+    """The payload's PoW parent is PAST the terminal block (its own parent
+    already reached TTD): the transition happened deeper in the chain and
+    this block is not the legitimate transition block."""
+    ttd = int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+    grandparent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x21" * 32),
+        parent_hash=spec.Hash32(b"\x00" * 32),
+        total_difficulty=spec.uint256(ttd),
+    )
+    parent = spec.PowBlock(
+        block_hash=spec.Hash32(b"\x22" * 32),
+        parent_hash=grandparent.block_hash,
+        total_difficulty=spec.uint256(ttd + 1),
+    )
+    yield from _merge_scenario(spec, state, [grandparent, parent],
+                               parent.block_hash, valid=False)
+
+
+@with_phases([BELLATRIX])
+@with_config_overrides({
+    "TERMINAL_BLOCK_HASH": "0x" + TERMINAL_OVERRIDE.hex(),
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+})
+@spec_state_test
+def test_on_merge_block_terminal_hash_override(spec, state):
+    """TERMINAL_BLOCK_HASH set: ancestry/difficulty checks are replaced by
+    an exact parent-hash equality (no PoW view needed)."""
+    yield from _merge_scenario(spec, state, [], TERMINAL_OVERRIDE, valid=True)
+
+
+@with_phases([BELLATRIX])
+@with_config_overrides({
+    "TERMINAL_BLOCK_HASH": "0x" + TERMINAL_OVERRIDE.hex(),
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+})
+@spec_state_test
+def test_on_merge_block_terminal_hash_override_wrong_parent(spec, state):
+    yield from _merge_scenario(spec, state, [], b"\x78" * 32, valid=False)
+
+
+@with_phases([BELLATRIX])
+@with_config_overrides({
+    "TERMINAL_BLOCK_HASH": "0x" + TERMINAL_OVERRIDE.hex(),
+    "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 2**32,
+})
+@spec_state_test
+def test_on_merge_block_terminal_hash_activation_not_reached(spec, state):
+    """The override only applies from its activation epoch: before it, a
+    block matching TERMINAL_BLOCK_HASH is still rejected."""
+    yield from _merge_scenario(spec, state, [], TERMINAL_OVERRIDE, valid=False)

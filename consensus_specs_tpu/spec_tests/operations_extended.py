@@ -459,3 +459,149 @@ def test_voluntary_exit_default_exit_epoch_subsequent_exit(spec, state):
     yield from _run_op(spec, state, "voluntary_exit", second)
     # under the churn limit both land on the same (default) exit epoch
     assert state.validators[1].exit_epoch == state.validators[0].exit_epoch
+
+# --- bellatrix execution payload: the reference's full matrix ----------------
+# (test/bellatrix/block_processing/test_process_execution_payload.py —
+# first-vs-regular payload, gap slots, engine rejection, combined-corruption
+# and extra-data cases; the wrong-parent/random/timestamp singles live in
+# spec_tests/operations.py)
+
+from ..testlib.bellatrix import complete_merge_transition  # noqa: E402
+from ..testlib.block import build_empty_execution_payload  # noqa: E402
+from ..testlib.context import (  # noqa: E402
+    BELLATRIX,
+    expect_assertion_error,
+    with_phases,
+)
+
+
+class RejectingExecutionEngine:
+    """Engine stub whose execute_payload always answers invalid — the
+    reference's bad-execution cases flip its NoopExecutionEngine the same
+    way (execute_payload lambda: False)."""
+
+    def execute_payload(self, execution_payload) -> bool:
+        return False
+
+    def notify_forkchoice_updated(self, head_block_hash, finalized_block_hash,
+                                  payload_attributes) -> None:
+        pass
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError
+
+
+def _make_pre_merge_state(spec, state):
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+
+
+def _first_payload(spec, state):
+    """A valid TRANSITION payload: parent/random consistency is not checked
+    for the first payload, the timestamp is."""
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x55" * 32)  # pre-merge: unchecked
+    return payload
+
+
+def _run_payload(spec, state, payload, engine=None, valid=True):
+    yield "pre", state.copy()
+    yield "execution_payload", payload
+    engine = engine if engine is not None else spec.EXECUTION_ENGINE
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, payload, engine))
+        return
+    spec.process_execution_payload(state, payload, engine)
+    yield "post", state.copy()
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_first_payload_success(spec, state):
+    _make_pre_merge_state(spec, state)
+    payload = _first_payload(spec, state)
+    yield from _run_payload(spec, state, payload)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_first_payload_with_gap_slot(spec, state):
+    _make_pre_merge_state(spec, state)
+    next_slots(spec, state, 3)
+    payload = _first_payload(spec, state)
+    yield from _run_payload(spec, state, payload)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_regular_payload_with_gap_slot(spec, state):
+    complete_merge_transition(spec, state)
+    next_slots(spec, state, 3)
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run_payload(spec, state, payload)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_bad_execution_first_payload(spec, state):
+    """The engine's verdict binds even for the transition payload."""
+    _make_pre_merge_state(spec, state)
+    payload = _first_payload(spec, state)
+    yield from _run_payload(spec, state, payload,
+                            engine=RejectingExecutionEngine(), valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_bad_execution_regular_payload(spec, state):
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from _run_payload(spec, state, payload,
+                            engine=RejectingExecutionEngine(), valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_bad_timestamp_first_payload(spec, state):
+    """The timestamp check applies to the FIRST payload too (unlike the
+    parent/random checks)."""
+    _make_pre_merge_state(spec, state)
+    payload = _first_payload(spec, state)
+    payload.timestamp = payload.timestamp + 1
+    yield from _run_payload(spec, state, payload, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_bad_everything_regular_payload(spec, state):
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = spec.Hash32(b"\x13" * 32)
+    payload.random = spec.Bytes32(b"\x14" * 32)
+    payload.timestamp = payload.timestamp + 1
+    yield from _run_payload(spec, state, payload, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_non_empty_extra_data_first_payload(spec, state):
+    """extra_data is opaque to consensus: any contents are VALID."""
+    _make_pre_merge_state(spec, state)
+    payload = _first_payload(spec, state)
+    payload.extra_data = spec.ByteList[spec.MAX_EXTRA_DATA_BYTES](b"\x42" * 12)
+    payload.block_hash = spec.Hash32(
+        spec.hash(spec.hash_tree_root(payload) + b"FAKE RLP HASH"))
+    yield from _run_payload(spec, state, payload)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_execution_non_empty_extra_data_regular_payload(spec, state):
+    complete_merge_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.extra_data = spec.ByteList[spec.MAX_EXTRA_DATA_BYTES](b"\x42" * 12)
+    payload.block_hash = spec.Hash32(
+        spec.hash(spec.hash_tree_root(payload) + b"FAKE RLP HASH"))
+    yield from _run_payload(spec, state, payload)

@@ -111,7 +111,13 @@ def misc_balances(spec):
 
 
 def _cached_genesis(spec, balances_fn, threshold_fn):
-    key = (spec.fork, spec.preset_name, balances_fn.__name__, threshold_fn.__name__)
+    # keyed by the MODULE, not (fork, preset): a with_config_overrides spec
+    # is a fresh module with its own SSZ classes, and a state built from
+    # another module's classes fails coercion/equality inside it (the
+    # get_spec singletons hit the cache as before; per-override modules
+    # build genesis fresh, which is also what correctness requires —
+    # overridden config can change genesis content)
+    key = (spec, balances_fn.__name__, threshold_fn.__name__)
     if key not in _state_cache:
         balances = balances_fn(spec)
         threshold = threshold_fn(spec)
@@ -283,15 +289,27 @@ def with_presets(presets, reason=None):
 
 
 def with_config_overrides(overrides: dict):
-    """Run with a modified runtime config (fresh spec module per overrides)."""
+    """Run with a modified runtime config (fresh spec module per overrides).
+
+    Generator mode also emits the overrides as a per-case `config.yaml`
+    part (reference context.py:493-525 does the same) — without it a
+    replay runs the vector against the DEFAULT config and the case is
+    unreproducible (caught by the round-5 fork_choice replay)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, spec, **kwargs):
-            from ..compiler.spec_compiler import build_spec
+            from ..compiler.spec_compiler import get_spec_with_overrides
 
-            patched = build_spec(spec.fork, spec.preset_name, config_overrides=overrides)
-            return fn(*args, spec=patched, **kwargs)
+            patched = get_spec_with_overrides(spec.fork, spec.preset_name, overrides)
+            parts = fn(*args, spec=patched, **kwargs)
+            if kwargs.get("generator_mode") and parts is not None:
+                serializable = {
+                    k: ("0x" + v.hex()) if isinstance(v, (bytes, bytearray)) else v
+                    for k, v in overrides.items()
+                }
+                parts = [("config", "data", serializable)] + list(parts)
+            return parts
 
         return wrapper
 

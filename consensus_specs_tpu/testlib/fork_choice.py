@@ -96,6 +96,15 @@ def add_checks_step(spec, store, steps):
     return head
 
 
+def add_pow_block_step(parts, steps, pow_block):
+    """Install a synthetic PoW block into the scenario (reference
+    tests/formats/fork_choice `on_pow_block` step: consumers feed it to
+    their get_pow_block view before the dependent beacon block arrives)."""
+    name = f"pow_block_{bytes(pow_block.block_hash).hex()[:16]}"
+    parts.append((name, pow_block))
+    steps.append({"pow_block": name})
+
+
 def finalize_steps(parts, steps):
     """Order: anchor parts, object parts, then steps.yaml last."""
     return parts + [("steps", "data", steps)]

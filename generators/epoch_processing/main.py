@@ -20,4 +20,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("epoch_processing", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("epoch_processing", ALL_MODS)

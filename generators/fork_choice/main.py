@@ -9,7 +9,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
 
 from consensus_specs_tpu.gen import run_state_test_generators
-from consensus_specs_tpu.spec_tests import fork_choice
+from consensus_specs_tpu.spec_tests import fork_choice, merge_fork_choice
 
 _HANDLERS = {
     "get_head": (fork_choice, "genesis_head"),
@@ -21,7 +21,8 @@ _HANDLERS = {
 ALL_MODS = {
     "phase0": _HANDLERS,
     "altair": _HANDLERS,
-    "bellatrix": _HANDLERS,
+    # the merge-transition matrix only exists at the bellatrix fork
+    "bellatrix": {**_HANDLERS, "on_merge_block": merge_fork_choice},
 }
 
 if __name__ == "__main__":

@@ -11,13 +11,14 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
 from consensus_specs_tpu.gen import run_state_test_generators
 
 from consensus_specs_tpu.spec_tests import operations as ops
+from consensus_specs_tpu.spec_tests import operations_extended as ops_ext
 from consensus_specs_tpu.spec_tests import sync_aggregate
 
 ALL_MODS = {
-    "phase0": {"operations": ops},
-    "altair": {"operations": ops, "sync_aggregate": sync_aggregate},
-    "bellatrix": {"operations": ops, "sync_aggregate": sync_aggregate},
+    "phase0": {"operations": [ops, ops_ext]},
+    "altair": {"operations": [ops, ops_ext], "sync_aggregate": sync_aggregate},
+    "bellatrix": {"operations": [ops, ops_ext], "sync_aggregate": sync_aggregate},
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("operations", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("operations", ALL_MODS)

@@ -18,4 +18,4 @@ ALL_MODS = {
 }
 
 if __name__ == "__main__":
-    run_state_test_generators("rewards", ALL_MODS, presets=("minimal",))
+    run_state_test_generators("rewards", ALL_MODS)

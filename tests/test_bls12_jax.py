@@ -244,3 +244,69 @@ def test_pairing_check_rlc_pairing():
     p2y[2] = np.asarray(K.fp_to_device(g1[1]))
     args_bad = args_valid[:6] + (jnp.asarray(p2x), jnp.asarray(p2y))
     assert not bool(K.pairing_check_rlc(*args_bad, zbits))
+
+
+@pytest.mark.slow
+def test_g2_device_ops_match_oracle():
+    """Device G2 (twist-coordinate) scalar mul + tree reduce vs the oracle:
+    Σ z_i·(k_i·G2) computed on device equals the oracle's point."""
+    import random as _random
+
+    rng = _random.Random(0xB15)
+    ks = [rng.randrange(2, 1 << 40) for _ in range(5)]
+    zs = [rng.randrange(1, 1 << 64) for _ in range(5)]
+    pts = [oracle.pt_to_affine(
+        oracle.FP2_FIELD, oracle.pt_mul(oracle.FP2_FIELD, oracle.G2_GEN, k))
+        for k in ks]
+    # oracle ground truth
+    acc = None
+    for k, z in zip(ks, zs):
+        p = oracle.pt_mul(oracle.FP2_FIELD, oracle.G2_GEN, (k * z) % oracle.R)
+        acc = p if acc is None else oracle.pt_add(oracle.FP2_FIELD, acc, p)
+    want = oracle.pt_to_affine(oracle.FP2_FIELD, acc)
+
+    enc = K.F.ints_to_mont_batch
+    qx = (enc([p[0][0] for p in pts]), enc([p[0][1] for p in pts]))
+    qy = (enc([p[1][0] for p in pts]), enc([p[1][1] for p in pts]))
+    one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), qx[0].shape).astype(qx[0].dtype)
+    one2 = (one, jnp.zeros_like(one))
+    zbits = jnp.asarray(np.array(
+        [[(z >> i) & 1 for i in range(64)] for z in zs], dtype=bool))
+    acc_dev = K.g2_sum_reduce(K.g2_scalar_mul_batch((qx, qy, one2), zbits))
+    ax, ay = K.g2_jacobian_to_affine(acc_dev)
+
+    def f2_int(c):
+        return (K.F.from_mont_int(np.asarray(c[0]).reshape(-1, K.F.NLIMBS)[0]),
+                K.F.from_mont_int(np.asarray(c[1]).reshape(-1, K.F.NLIMBS)[0]))
+
+    assert f2_int(ax) == want[0] and f2_int(ay) == want[1]
+
+
+@pytest.mark.slow
+def test_pairing_check_rlc_neg_g1_collapse():
+    """The bilinearity-collapsed fast path (p2_is_neg_g1=True): valid
+    signature batch passes; a tampered signature fails."""
+    from consensus_specs_tpu.crypto.bls_jax import (
+        bench_pairing_args, random_zbits,
+    )
+
+    args = bench_pairing_args(4, distinct=2)
+    zbits = random_zbits(4)
+    assert bool(K.pairing_check_rlc(*args, zbits, p2_is_neg_g1=True))
+
+    # tamper one signature: double it (still a valid curve point, wrong sig)
+    q2x, q2y = args[4], args[5]
+    one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), args[2].shape).astype(args[2].dtype)
+    one2 = (one, jnp.zeros_like(one))
+    dbl = K.g2_double((q2x, q2y, one2))
+    dx, dy = K.g2_jacobian_to_affine(dbl)
+
+    def splice(orig, new):
+        a = np.asarray(orig).copy()
+        a[1] = np.asarray(new[1])
+        return jnp.asarray(a)
+
+    bad_q2x = (splice(q2x[0], dx[0]), splice(q2x[1], dx[1]))
+    bad_q2y = (splice(q2y[0], dy[0]), splice(q2y[1], dy[1]))
+    bad = args[:4] + (bad_q2x, bad_q2y) + args[6:]
+    assert not bool(K.pairing_check_rlc(*bad, zbits, p2_is_neg_g1=True))

@@ -22,6 +22,7 @@ def _fast_bls():
 from consensus_specs_tpu.spec_tests.finality import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.operations_extended import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.fork_choice import *  # noqa: E402,F401,F403
+from consensus_specs_tpu.spec_tests.merge_fork_choice import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.forks import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.genesis import *  # noqa: E402,F401,F403
 from consensus_specs_tpu.spec_tests.p2p import *  # noqa: E402,F401,F403
