@@ -110,9 +110,25 @@ def _replay_operations(spec, case_dir, meta):
     table["randao"] = (spec.BeaconBlockBody, spec.process_randao)
     table["eth1_data"] = (spec.BeaconBlockBody, spec.process_eth1_data)
     if hasattr(spec, "ExecutionPayload"):
+        # execution.yaml carries the mocked engine's verdict (reference
+        # operations/execution_payload format: execution_valid) — without
+        # it a bad-execution vector would replay through the always-happy
+        # Noop engine and wrongly succeed
+        execution_meta = _read_yaml(case_dir, "execution") or {}
+        engine_valid = bool(execution_meta.get("execution_valid", True))
+
+        class _VectorEngine:
+            def execute_payload(self, execution_payload) -> bool:
+                return engine_valid
+
+            def notify_forkchoice_updated(self, head_block_hash,
+                                          finalized_block_hash,
+                                          payload_attributes) -> None:
+                pass
+
         table["execution_payload"] = (
             spec.ExecutionPayload,
-            lambda st, op: spec.process_execution_payload(st, op, spec.EXECUTION_ENGINE),
+            lambda st, op: spec.process_execution_payload(st, op, _VectorEngine()),
         )
     typ, process = table[op_name]
     operation = _read_ssz(case_dir, op_name, typ)
