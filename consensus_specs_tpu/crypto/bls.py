@@ -237,10 +237,18 @@ def clear_sign_cache() -> None:
 def clear_caches() -> None:
     """Drop every host-side crypto cache: the Sign memo plus the jax
     backend's committee-aggregate LRU and point-decode/hash-to-curve
-    lru_caches (g1_from_bytes alone can hold ~0.5 GB at its default size)."""
-    clear_sign_cache()
-    from . import bls_jax
+    lru_caches (g1_from_bytes alone can hold ~0.5 GB at its default size).
 
+    The jax-backend caches are cleared only if `bls_jax` has already been
+    imported — importing it here would drag in jax (and initialize a
+    backend) from a pure-host code path that never used it, just to clear
+    caches that cannot have entries."""
+    import sys
+
+    clear_sign_cache()
+    bls_jax = sys.modules.get(__package__ + ".bls_jax")
+    if bls_jax is None:
+        return
     bls_jax._AGG_CACHE.clear()
     bls_jax.g1_from_bytes.cache_clear()
     bls_jax.g2_from_bytes.cache_clear()

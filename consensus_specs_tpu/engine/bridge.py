@@ -17,7 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .epoch import epoch_fn_for, historical_batch_root
-from .state import EpochConfig, EpochState
+from .state import DIRTY_TRACKED, EpochConfig, EpochState
 from .sync_committee import next_sync_committee_indices
 
 
@@ -81,36 +81,74 @@ def state_to_device_with_columns(spec, state):
     vals = state.validators
     n = len(vals)
     cols = _cached_validator_columns(vals)
+    # The epoch program DONATES its input (epoch_fn_for / the resident step),
+    # and on the CPU backend jnp.asarray can adopt a host numpy buffer
+    # zero-copy — XLA then reuses that very memory for donated outputs and
+    # scratch. That both scribbles any host array we retain (the memoized
+    # cols feeding the write-back diff) and leaves the output aliasing
+    # memory whose owning numpy temporary is gone. So every array entering
+    # the donated program goes through jnp.array (copy=True): the device
+    # buffer is jax-owned and donation recycles only jax-owned memory.
     dev = EpochState(
         slot=jnp.uint64(int(state.slot)),
-        balances=jnp.asarray(state.balances.to_numpy()),
-        effective_balance=jnp.asarray(cols["effective_balance"]),
-        activation_eligibility_epoch=jnp.asarray(cols["activation_eligibility_epoch"]),
-        activation_epoch=jnp.asarray(cols["activation_epoch"]),
-        exit_epoch=jnp.asarray(cols["exit_epoch"]),
-        withdrawable_epoch=jnp.asarray(cols["withdrawable_epoch"]),
-        slashed=jnp.asarray(cols["slashed"]),
-        prev_participation=jnp.asarray(state.previous_epoch_participation.to_numpy()),
-        curr_participation=jnp.asarray(state.current_epoch_participation.to_numpy()),
-        inactivity_scores=jnp.asarray(state.inactivity_scores.to_numpy()),
-        slashings=jnp.asarray(state.slashings.to_numpy()),
-        randao_mixes=jnp.asarray(_roots_to_words(state.randao_mixes)),
-        block_roots=jnp.asarray(_roots_to_words(state.block_roots)),
-        state_roots=jnp.asarray(_roots_to_words(state.state_roots)),
-        justification_bits=jnp.asarray(np.array([bool(b) for b in state.justification_bits])),
+        balances=jnp.array(state.balances.to_numpy()),
+        effective_balance=jnp.array(cols["effective_balance"]),
+        activation_eligibility_epoch=jnp.array(cols["activation_eligibility_epoch"]),
+        activation_epoch=jnp.array(cols["activation_epoch"]),
+        exit_epoch=jnp.array(cols["exit_epoch"]),
+        withdrawable_epoch=jnp.array(cols["withdrawable_epoch"]),
+        slashed=jnp.array(cols["slashed"]),
+        prev_participation=jnp.array(state.previous_epoch_participation.to_numpy()),
+        curr_participation=jnp.array(state.current_epoch_participation.to_numpy()),
+        inactivity_scores=jnp.array(state.inactivity_scores.to_numpy()),
+        slashings=jnp.array(state.slashings.to_numpy()),
+        randao_mixes=jnp.array(_roots_to_words(state.randao_mixes)),
+        block_roots=jnp.array(_roots_to_words(state.block_roots)),
+        state_roots=jnp.array(_roots_to_words(state.state_roots)),
+        justification_bits=jnp.array([bool(b) for b in state.justification_bits]),
         prev_justified_epoch=jnp.uint64(int(state.previous_justified_checkpoint.epoch)),
-        prev_justified_root=jnp.asarray(_root_to_words(state.previous_justified_checkpoint.root)),
+        prev_justified_root=jnp.array(_root_to_words(state.previous_justified_checkpoint.root)),
         curr_justified_epoch=jnp.uint64(int(state.current_justified_checkpoint.epoch)),
-        curr_justified_root=jnp.asarray(_root_to_words(state.current_justified_checkpoint.root)),
+        curr_justified_root=jnp.array(_root_to_words(state.current_justified_checkpoint.root)),
         finalized_epoch=jnp.uint64(int(state.finalized_checkpoint.epoch)),
-        finalized_root=jnp.asarray(_root_to_words(state.finalized_checkpoint.root)),
+        finalized_root=jnp.array(_root_to_words(state.finalized_checkpoint.root)),
     )
     assert n == dev.balances.shape[0]
     return dev, cfg, cols
 
 
+def write_back_full_bytes(dev: EpochState) -> int:
+    """Bytes a full materialization moves D2H: every DIRTY_TRACKED column."""
+    return sum(int(getattr(dev, name).nbytes) for name in DIRTY_TRACKED)
+
+
 def _write_back(spec, state, dev: EpochState, pre_cols: dict,
-                pre_mixes: np.ndarray | None = None) -> None:
+                pre_mixes: np.ndarray | None = None,
+                dirty: dict | None = None,
+                mix_rows=None) -> dict:
+    """Write device columns back into the spec BeaconState.
+
+    `dirty`: optional {column name -> bool} over DIRTY_TRACKED (from
+    EpochAux.dirty_cols). Clean columns are skipped entirely — no D2H
+    transfer, no host reconstruction. `None` means "assume everything
+    dirty" (the full-materialize path).
+
+    `mix_rows`: optional iterable of randao_mixes row indices known (from
+    the epoch schedule: each transition into epoch e writes row e % EPV) to
+    cover every possibly-dirty row. When given, only those rows are gathered
+    from device (32 B each) instead of the whole (EPV, 8) vector; `pre_mixes`
+    is updated in place so the caller's diff base stays coherent.
+
+    Returns transfer accounting: {"moved_bytes", "full_bytes",
+    "clean_cols"} where full_bytes is what a dirty-oblivious materialize
+    would have moved for the same columns.
+    """
+    def is_dirty(name: str) -> bool:
+        return dirty is None or bool(dirty.get(name, True))
+
+    moved = 0
+    full = write_back_full_bytes(dev)
+    clean: list[str] = []
     # Registry fields: diff against the pre-epoch columns and touch only the
     # validators a sub-transition actually mutated (activation churn,
     # hysteresis, ejections — typically a small fraction of the registry).
@@ -121,32 +159,62 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict,
         "activation_epoch": spec.Epoch,
         "exit_epoch": spec.Epoch,
         "withdrawable_epoch": spec.Epoch,
+        "slashed": spec.boolean,
     }
     for name, typ in field_types.items():
-        post = np.asarray(getattr(dev, name))
+        if not is_dirty(name):
+            clean.append(name)
+            continue
+        # Owning copy, NOT np.asarray: this array outlives `dev` as the
+        # memoized diff base (pre_cols), so it must not alias device memory.
+        post = np.array(getattr(dev, name))
+        moved += post.nbytes
         changed = np.nonzero(post != pre_cols[name])[0]
         values = post[changed].tolist()
         for i, value in zip(changed.tolist(), values):
             setattr(vals[i], name, typ(value))
         pre_cols[name] = post  # keep the memoized columns post-epoch coherent
     # Whole-registry vectors: bulk one-pass reconstruction.
-    state.balances = type(state.balances).from_numpy(np.asarray(dev.balances))
-    state.inactivity_scores = type(state.inactivity_scores).from_numpy(
-        np.asarray(dev.inactivity_scores))
-    state.previous_epoch_participation = type(state.previous_epoch_participation).from_numpy(
-        np.asarray(dev.prev_participation))
-    state.current_epoch_participation = type(state.current_epoch_participation).from_numpy(
-        np.asarray(dev.curr_participation))
-    state.slashings = type(state.slashings).from_numpy(np.asarray(dev.slashings))
-    mixes = np.asarray(dev.randao_mixes)
-    if pre_mixes is not None:
-        # epoch processing touches at most one mix slot; diff and write only
-        # the changed rows (65536 Bytes32 writes -> ~1)
-        changed_rows = np.nonzero((mixes != pre_mixes).any(axis=1))[0].tolist()
+    bulk_fields = {
+        "balances": "balances",
+        "inactivity_scores": "inactivity_scores",
+        "prev_participation": "previous_epoch_participation",
+        "curr_participation": "current_epoch_participation",
+        "slashings": "slashings",
+    }
+    for dev_name, state_name in bulk_fields.items():
+        if not is_dirty(dev_name):
+            clean.append(dev_name)
+            continue
+        # Owning copy: from_numpy ADOPTS this array as the SSZ list's
+        # columnar backing, which outlives `dev` (and must be writable).
+        post = np.array(getattr(dev, dev_name))
+        moved += post.nbytes
+        cur = getattr(state, state_name)
+        setattr(state, state_name, type(cur).from_numpy(post))
+    if not is_dirty("randao_mixes"):
+        clean.append("randao_mixes")
+    elif mix_rows is not None:
+        rows = sorted({int(r) for r in mix_rows})
+        if rows:
+            gathered = np.asarray(dev.randao_mixes[jnp.asarray(rows)])
+            moved += gathered.nbytes
+            for i, words in zip(rows, gathered):
+                state.randao_mixes[i] = spec.Bytes32(_words_to_root(words))
+                if pre_mixes is not None:
+                    pre_mixes[i] = words
     else:
-        changed_rows = range(mixes.shape[0])
-    for i in changed_rows:
-        state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
+        mixes = np.asarray(dev.randao_mixes)
+        moved += mixes.nbytes
+        if pre_mixes is not None:
+            # epoch processing touches at most one mix slot per epoch; diff
+            # and write only the changed rows (65536 Bytes32 writes -> ~1)
+            changed_rows = np.nonzero((mixes != pre_mixes).any(axis=1))[0].tolist()
+            pre_mixes[:] = mixes
+        else:
+            changed_rows = range(mixes.shape[0])
+        for i in changed_rows:
+            state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
     for i, b in enumerate(np.asarray(dev.justification_bits)):
         state.justification_bits[i] = bool(b)
     state.previous_justified_checkpoint = spec.Checkpoint(
@@ -164,6 +232,7 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict,
     # Re-key the memoized columns to the post-epoch registry root (the root
     # is incremental: only the mutated validators' paths rehash here).
     vals.__dict__["_engine_cols"] = (vals.hash_tree_root(), pre_cols)
+    return {"moved_bytes": moved, "full_bytes": full, "clean_cols": clean}
 
 
 def install_next_sync_committee(spec, state, active, eff, seed: bytes) -> None:
@@ -202,24 +271,47 @@ def _rotate_sync_committees(spec, state) -> None:
     install_next_sync_committee(spec, state, active, eff, bytes(seed))
 
 
-def apply_epoch_via_engine(spec, state, stage_timer=None) -> None:
+def apply_epoch_via_engine(spec, state, stage_timer=None, dirty_aware=True,
+                           stats=None) -> None:
     """Mutating `process_epoch` replacement running the device engine.
 
     `stage_timer(name)`: optional callable invoked after each stage —
     bridge_in / device / write_back — so benchmarks (benches/
     epoch_e2e_bench.py) time the REAL pipeline instead of re-implementing
-    it."""
+    it.
+
+    `dirty_aware=True` consumes EpochAux.dirty_cols so the write-back only
+    transfers columns this transition mutated, and fetches the single
+    schedule-known randao row instead of the whole mix vector. `False`
+    forces the dirty-oblivious full materialization (the conformance oracle
+    for the differential tests and the bench's comparison lane).
+
+    `stats`: optional dict updated with the write-back transfer accounting
+    ({"moved_bytes", "full_bytes", "clean_cols"})."""
     import jax
 
     tick = stage_timer or (lambda name: None)
     dev, cfg, pre_cols = state_to_device_with_columns(spec, state)
-    pre_mixes = np.asarray(dev.randao_mixes)
+    pre_mixes = np.array(dev.randao_mixes)  # writable: _write_back updates it
     tick("bridge_in")
     dev_out, aux = epoch_fn_for(cfg)(dev)
     if stage_timer is not None:
         jax.block_until_ready(dev_out.balances)
     tick("device")
-    _write_back(spec, state, dev_out, pre_cols, pre_mixes)
+    if dirty_aware:
+        flags = np.asarray(aux.dirty_cols)
+        dirty = {name: bool(f) for name, f in zip(DIRTY_TRACKED, flags)}
+        # The only mix row an epoch transition can write is the one for the
+        # epoch being entered: next_epoch % EPOCHS_PER_HISTORICAL_VECTOR.
+        next_epoch = int(state.slot) // int(spec.SLOTS_PER_EPOCH) + 1
+        mix_rows = [next_epoch % int(spec.EPOCHS_PER_HISTORICAL_VECTOR)]
+    else:
+        dirty = None
+        mix_rows = None
+    wb = _write_back(spec, state, dev_out, pre_cols, pre_mixes,
+                     dirty=dirty, mix_rows=mix_rows)
+    if stats is not None:
+        stats.update(wb)
     if bool(aux.eth1_votes_reset):
         state.eth1_data_votes = type(state.eth1_data_votes)()
     if bool(aux.historical_append):

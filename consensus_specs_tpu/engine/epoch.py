@@ -340,6 +340,7 @@ def make_epoch_fn(cfg: EpochConfig, with_jit: bool = True):
         return st.replace(effective_balance=jnp.where(moved, new_eff, eff))
 
     def process_epoch(st: EpochState):
+        pre = st  # pre-transition columns: live values inside the program
         cur = current_epoch_of(st.slot)
         nxt = cur + _u(1)
 
@@ -363,18 +364,28 @@ def make_epoch_fn(cfg: EpochConfig, with_jit: bool = True):
                 st.randao_mixes[(cur % ephv).astype(jnp.int64)]
             )
         )
-        # process_historical_roots_update: the host bridge calls
-        # historical_batch_root() (separately jitted) when the flag fires
-        epochs_per_batch = cfg.slots_per_historical_root // cfg.slots_per_epoch
-        aux = EpochAux(
-            historical_append=(nxt % _u(epochs_per_batch)) == _u(0),
-            eth1_votes_reset=(nxt % _u(cfg.epochs_per_eth1_voting_period)) == _u(0),
-            sync_committee_update=(nxt % _u(cfg.epochs_per_sync_committee_period)) == _u(0),
-        )
         # process_participation_flag_updates
         st = st.replace(
             prev_participation=st.curr_participation,
             curr_participation=jnp.zeros_like(st.curr_participation),
+        )
+        # process_historical_roots_update: the host bridge calls
+        # historical_batch_root() (separately jitted) when the flag fires
+        epochs_per_batch = cfg.slots_per_historical_root // cfg.slots_per_epoch
+        from .state import DIRTY_TRACKED
+
+        aux = EpochAux(
+            historical_append=(nxt % _u(epochs_per_batch)) == _u(0),
+            eth1_votes_reset=(nxt % _u(cfg.epochs_per_eth1_voting_period)) == _u(0),
+            sync_committee_update=(nxt % _u(cfg.epochs_per_sync_committee_period)) == _u(0),
+            # value-level dirty flags over the FINAL state: a column whose
+            # sub-transition wrote only identical values (slashings row
+            # already zero, effective balance stable under hysteresis, ...)
+            # reads as clean, so the write-back never moves it
+            dirty_cols=jnp.stack([
+                jnp.any(getattr(st, name) != getattr(pre, name))
+                for name in DIRTY_TRACKED
+            ]),
         )
         return st, aux
 

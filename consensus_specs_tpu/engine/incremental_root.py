@@ -270,7 +270,25 @@ class IncrementalStateRoot:
         next_epoch % EPSV) — within an EPV/EPSV window the rows are
         distinct, so path-updating each touched row against the FINAL
         device state is exact. The registry columns are diffed on device
-        once for the whole run (cumulative dirty set)."""
+        once for the whole run (cumulative dirty set).
+
+        CONTRACT — epoch-only mutator: between the build (or previous
+        refresh) and this call, `dev` may have been advanced ONLY by epoch
+        transitions (engine/epoch.py programs), whose write set is exactly
+        what is re-derived here, plus the per-slot root writes that went
+        through record_state_root/record_block_root. Any other mutation of
+        the registry-scale fields (e.g. a future block-processing program
+        editing balances mid-epoch, appending validators, or rewriting
+        history vectors wholesale) is NOT observed and would silently yield
+        a stale root — route such writes through a rebuild (fresh
+        IncrementalStateRoot) or a dedicated record_* hook instead. The
+        shape guard below makes the registry-growth case fail loudly."""
+        if int(dev.balances.shape[0]) != self.n:
+            raise ValueError(
+                f"IncrementalStateRoot built for {self.n} validators, got a "
+                f"state with {int(dev.balances.shape[0])}: registry growth "
+                "is outside the epoch-only mutator contract — rebuild the "
+                "incremental root cache")
         self._light = _wholesale_roots_fn()(dev)
 
         count_dirty, idxs, copies = _dirty_scan_fn()(

@@ -34,7 +34,7 @@ import numpy as np
 
 from . import bridge
 from .epoch import historical_batch_root, make_epoch_fn
-from .state import EpochConfig
+from .state import DIRTY_TRACKED, EpochConfig
 
 
 def _step_body(cfg: EpochConfig):
@@ -79,6 +79,16 @@ def resident_scan_fn_for(cfg: EpochConfig, k: int):
     return jax.jit(scan_k, donate_argnums=(0,))
 
 
+def _start_host_copies(aux) -> None:
+    """Queue async D2H copies of every EpochAux leaf right behind the launch
+    that produces them, so the later np.asarray readout in _flush_pending
+    completes the transfers instead of starting them (overlap with whatever
+    the host does in between). No-op on backends without the API."""
+    for leaf in jax.tree_util.tree_leaves(aux):
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
+
+
 class ResidentEpochEngine:
     """Runs epochs with the registry resident in device HBM.
 
@@ -102,29 +112,87 @@ class ResidentEpochEngine:
         self.cfg = cfg
         self.dev = dev
         self._pre_cols = cols
-        self._pre_mixes = np.asarray(dev.randao_mixes)
+        # writable copy: the write-back maintains it in place (by gathered
+        # row, or wholesale on the full-diff fallback)
+        self._pre_mixes = np.array(dev.randao_mixes)
         self._step = resident_step_fn_for(cfg)
         self._inc = None  # incremental root cache, built on first state_root()
         self._pending_epochs = 0  # epoch refreshes owed to the cache
+        self._pending_last_epoch = int(state.slot) // cfg.slots_per_epoch
+        # Dirty-column accumulator: OR of EpochAux.dirty_cols over every
+        # epoch since the last materialize(); lets the write-back skip the
+        # D2H transfer of columns no transition touched.
+        self._dirty = np.zeros(len(DIRTY_TRACKED), dtype=bool)
+        self._epochs_since_sync = 0
+        # Deferred segment service (pipelining): the EpochAux of the most
+        # recent launch whose host epilogues have not run yet, plus the
+        # number of epochs it covers. Flushed before any host-visible read
+        # and eagerly when the segment fires a sync-committee rotation.
+        self._pending = None
+        self._deferred_epochs = 0
 
     def step_epoch(self, advance_slots: bool = True) -> None:
         """One epoch transition; host work is O(1) except on period
         boundaries (see module docstring). `advance_slots=False` is the
-        per-slot drive mode's boundary step (advance_slot owns the +1)."""
+        per-slot drive mode's boundary step (advance_slot owns the +1).
+
+        In the default mode the epilogue service of the PREVIOUS epoch is
+        flushed after this epoch's launch, so its flag readout and host
+        work overlap this epoch's device compute. The deferral is exact
+        for the same reasons segment deferral is (see run_epochs); a
+        rotation epoch is serviced eagerly because its sampler must read
+        the registry columns before the next launch donates them.
+        """
+        if not advance_slots:
+            # per-slot mode interleaves advance_slot's root-vector writes
+            # with epoch steps, so nothing may stay deferred across one.
+            self._flush_pending()
+            self.dev, aux = self._step(self.dev)
+            self._service_segment(
+                np.asarray(aux.eth1_votes_reset)[None],
+                np.asarray(aux.historical_append)[None],
+                np.asarray(aux.sync_committee_update)[None],
+                dirty_cols=np.asarray(aux.dirty_cols)[None],
+                advance_slots=False,
+            )
+            return
+        cur = int(self.state.slot) // self.cfg.slots_per_epoch + self._deferred_epochs
         self.dev, aux = self._step(self.dev)
+        _start_host_copies(aux)
+        self._flush_pending()  # previous epoch's epilogues overlap this launch
+        self._pending = aux
+        self._deferred_epochs = 1
+        if (cur + 1) % self.cfg.epochs_per_sync_committee_period == 0:
+            self._flush_pending()  # this epoch rotates: service it now
+
+    def _flush_pending(self) -> None:
+        """Run the deferred epilogue service, if any. Reading the aux
+        arrays blocks until their launch (and the async host copies kicked
+        off at dispatch) complete."""
+        aux = self._pending
+        if aux is None:
+            return
+        self._pending = None
+        self._deferred_epochs = 0
+        d = np.asarray(aux.dirty_cols)
         self._service_segment(
-            np.asarray(aux.eth1_votes_reset)[None],
-            np.asarray(aux.historical_append)[None],
-            np.asarray(aux.sync_committee_update)[None],
-            advance_slots=advance_slots,
+            np.atleast_1d(np.asarray(aux.eth1_votes_reset)),
+            np.atleast_1d(np.asarray(aux.historical_append)),
+            np.atleast_1d(np.asarray(aux.sync_committee_update)),
+            dirty_cols=d[None] if d.ndim == 1 else d,
         )
 
     def _service_segment(self, eth1_resets, hist_appends, sync_updates,
-                         advance_slots: bool = True) -> None:
+                         dirty_cols=None, advance_slots: bool = True) -> None:
         """Host epilogues + slot-mirror advance for a segment of epochs,
         given the (seg,) aux flag arrays. Shared by step_epoch (seg=1) and
         run_epochs — the deferral-correctness argument lives on run_epochs."""
         seg = len(eth1_resets)
+        if dirty_cols is not None:
+            self._dirty |= np.asarray(dirty_cols).any(axis=0)
+        else:
+            self._dirty[:] = True  # unknown provenance: assume everything moved
+        self._epochs_since_sync += seg
         if not advance_slots:
             # per-slot mode: the mirror sits at the epoch's LAST slot and
             # advance_slot increments it after this returns
@@ -182,22 +250,30 @@ class ResidentEpochEngine:
           statically from the period schedule.
 
         Flag readout is one (seg_len, 3) fetch per segment instead of
-        three bools per epoch.
+        three bools per epoch — and it is PIPELINED: the aux host copies
+        are started asynchronously at dispatch, and a segment that does
+        not end at a rotation boundary (only ever the final one) stays
+        deferred past return, so its epilogue service overlaps whatever
+        the caller does next. Rotation segments are serviced before the
+        following launch donates the registry columns their sampler reads.
         """
         period = self.cfg.epochs_per_sync_committee_period
         done = 0
         while done < k:
             # epochs remaining in the CURRENT period (next_epoch = cur+1
-            # triggers rotation when it hits a multiple of the period)
-            cur = int(self.state.slot) // self.cfg.slots_per_epoch
+            # triggers rotation when it hits a multiple of the period);
+            # the slot mirror lags by any still-deferred epochs.
+            cur = (int(self.state.slot) // self.cfg.slots_per_epoch
+                   + self._deferred_epochs)
             to_boundary = period - 1 - (cur % period) + 1  # epochs incl. the one firing rotation
             seg = min(k - done, to_boundary)
             self.dev, auxes = resident_scan_fn_for(self.cfg, seg)(self.dev)
-            self._service_segment(
-                np.asarray(auxes.eth1_votes_reset),
-                np.asarray(auxes.historical_append),
-                np.asarray(auxes.sync_committee_update),
-            )
+            _start_host_copies(auxes)
+            self._flush_pending()  # previous segment overlaps this launch
+            self._pending = auxes
+            self._deferred_epochs = seg
+            if seg == to_boundary:
+                self._flush_pending()  # segment rotates: service it now
             done += seg
 
     def _rotate_sync_committees_resident(self) -> None:
@@ -232,12 +308,44 @@ class ResidentEpochEngine:
         )
         bridge.install_next_sync_committee(spec, state, active, eff, bytes(seed))
 
-    def materialize(self) -> None:
-        """Sync the host `BeaconState` to the device state: the one full
+    def materialize(self) -> dict:
+        """Sync the host `BeaconState` to the device state: the one
         write-back, identical in effect to the per-epoch write-back of the
-        sequential loop (diff-based registry update + bulk vectors)."""
-        bridge._write_back(self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes)
-        self._pre_mixes = np.asarray(self.dev.randao_mixes)
+        sequential loop (diff-based registry update + bulk vectors) — but
+        DIRTY-AWARE: only columns some transition since the last sync
+        actually mutated cross the host boundary, and the randao mix
+        vector is gathered by its schedule-known touched rows (each epoch
+        entered writes exactly row epoch % EPOCHS_PER_HISTORICAL_VECTOR)
+        instead of wholesale. Transfers of the dirty columns are staged
+        asynchronously before the sequential host reconstruction starts.
+
+        Returns the transfer accounting dict from bridge._write_back
+        ({"moved_bytes", "full_bytes", "clean_cols"})."""
+        self._flush_pending()
+        dirty = {name: bool(f) for name, f in zip(DIRTY_TRACKED, self._dirty)}
+        epv = self.cfg.epochs_per_historical_vector
+        since = self._epochs_since_sync
+        if dirty.get("randao_mixes") and 0 < since < epv:
+            last = self._pending_last_epoch
+            mix_rows = sorted({e % epv for e in range(last - since + 1, last + 1)})
+        else:
+            mix_rows = None  # wraparound (or nothing ran): full diff path
+        # Stage the D2H copies of every column the write-back will fetch,
+        # so the transfers run while the host loop reconstructs earlier
+        # columns (np.asarray in _write_back then completes, not starts,
+        # each copy). randao is excluded when row-gathered.
+        for name, isdirty in dirty.items():
+            if not isdirty or (name == "randao_mixes" and mix_rows is not None):
+                continue
+            arr = getattr(self.dev, name)
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        stats = bridge._write_back(
+            self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes,
+            dirty=dirty, mix_rows=mix_rows)
+        self._dirty[:] = False
+        self._epochs_since_sync = 0
+        return stats
 
     def state_root(self) -> bytes:
         """hash_tree_root(BeaconState) WITHOUT materializing.
@@ -256,6 +364,7 @@ class ResidentEpochEngine:
         from .incremental_root import IncrementalStateRoot
         from .state_root import assemble_state_root, validator_static_leaves
 
+        self._flush_pending()
         if self._inc is None:
             if not hasattr(self, "_static_leaves"):
                 self._static_leaves = jnp.asarray(validator_static_leaves(self.state))
@@ -290,6 +399,7 @@ class ResidentEpochEngine:
         step_epoch()/run_epochs() — slot accounting is owned here in this
         mode (step_epoch(advance_slots=False))."""
         spec, state, cfg = self.spec, self.state, self.cfg
+        self._flush_pending()
         prev_root = self.state_root()
         idx = int(state.slot) % cfg.slots_per_historical_root
         root_words = jnp.asarray(np.frombuffer(prev_root, dtype=">u4").astype(np.uint32))

@@ -165,6 +165,29 @@ class EpochState:
         return self.balances.shape[0]
 
 
+# Columns the dirty-tracking machinery watches, in flag order. These are
+# every registry-scale field the epoch program CAN mutate; whether a given
+# transition DID mutate one is decided on device by value comparison
+# (EpochAux.dirty_cols below), so the host write-back fetches only columns
+# that really changed. block_roots/state_roots are absent on purpose: the
+# epoch program never writes them (they are process_slot effects, owned by
+# the host / advance_slot path).
+DIRTY_TRACKED: tuple = (
+    "balances",
+    "effective_balance",
+    "activation_eligibility_epoch",
+    "activation_epoch",
+    "exit_epoch",
+    "withdrawable_epoch",
+    "slashed",
+    "prev_participation",
+    "curr_participation",
+    "inactivity_scores",
+    "slashings",
+    "randao_mixes",
+)
+
+
 @struct.dataclass
 class EpochAux:
     """Side outputs of the device epoch step consumed by the host bridge."""
@@ -172,3 +195,9 @@ class EpochAux:
     historical_append: jax.Array  # () bool — bridge merkleizes + appends
     eth1_votes_reset: jax.Array  # () bool
     sync_committee_update: jax.Array  # () bool — host recomputes committees
+    # (len(DIRTY_TRACKED),) bool — dirty_cols[i] is True iff the transition
+    # changed any element of DIRTY_TRACKED[i]. Computed inside the jitted
+    # epoch program (both pre and post values are live there even when the
+    # input is donated); costs one O(N) compare per column on device and
+    # lets the write-back skip the D2H transfer of clean columns entirely.
+    dirty_cols: jax.Array

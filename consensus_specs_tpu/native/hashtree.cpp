@@ -24,6 +24,7 @@
 #if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
 #define HASHTREE_X86 1
 #include <immintrin.h>
+#include <cpuid.h>
 #endif
 
 namespace {
@@ -185,8 +186,14 @@ void compress_shani(uint32_t state[8], const uint8_t block[64]) {
 }
 
 bool have_shani() {
-  static const bool v =
-      __builtin_cpu_supports("sha") && __builtin_cpu_supports("sse4.1");
+  // __builtin_cpu_supports has no "sha" feature name on older GCC; read
+  // CPUID leaf 7 (EBX bit 29 = SHA extensions) directly.
+  static const bool v = [] {
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+    const bool sha = (ebx >> 29) & 1u;
+    return sha && __builtin_cpu_supports("sse4.1");
+  }();
   return v;
 }
 #endif  // HASHTREE_X86

@@ -70,14 +70,20 @@ def compress(data: bytes) -> bytes:
 MAX_UNCOMPRESSED_LEN = 1 << 30
 
 
-def decompress(data: bytes) -> bytes:
+def decompress(data: bytes, max_len: int = MAX_UNCOMPRESSED_LEN) -> bytes:
+    """Decompress one snappy block stream.
+
+    `max_len` caps the DECLARED uncompressed length before any allocation;
+    callers that know their protocol bound should pass it (the gossip
+    driver passes its 2^20 message cap) so an attacker-crafted preamble is
+    rejected at the protocol's own limit instead of the 1 GiB backstop."""
     lib = _load()
     if lib is None:
-        return _py_decompress(data)
+        return _py_decompress(data, max_len)
     size = lib.snappy_tpu_uncompressed_length(data, len(data))
     if size < 0:
         raise ValueError("snappy: bad length preamble")
-    if size > MAX_UNCOMPRESSED_LEN:
+    if size > max_len:
         raise ValueError("snappy: declared length exceeds limit")
     out = ctypes.create_string_buffer(max(size, 1))
     n = lib.snappy_tpu_decompress(data, len(data), out, size)
@@ -142,7 +148,7 @@ def _py_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
-def _py_decompress(data: bytes) -> bytes:
+def _py_decompress(data: bytes, max_len: int = MAX_UNCOMPRESSED_LEN) -> bytes:
     ip = 0
     size = shift = 0
     while True:
@@ -152,7 +158,7 @@ def _py_decompress(data: bytes) -> bytes:
         if not b & 0x80:
             break
         shift += 7
-    if size > MAX_UNCOMPRESSED_LEN:
+    if size > max_len:
         raise ValueError("snappy: declared length exceeds limit")
     out = bytearray()
     while ip < len(data):

@@ -43,7 +43,10 @@ def _compress(state, w16):
         s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> jnp.uint32(10))
         return jax.lax.dynamic_update_index_in_dim(w, w16_ + s0 + w7 + s1, t, axis=-1)
 
-    w = jax.lax.fori_loop(16, 64, sched, w)
+    # int32 loop bounds: under x64 the induction var would be s64, and the
+    # GSPMD partitioner emits s32 offset math for the dynamic slices — the
+    # mixed-width compare fails HLO verification on sharded programs.
+    w = jax.lax.fori_loop(jnp.int32(16), jnp.int32(64), sched, w)
 
     def round_fn(t, vars8):
         a, b, c, d, e, f, g, h = vars8
@@ -56,7 +59,7 @@ def _compress(state, w16):
         t2 = s0 + maj
         return (t1 + t2, a, b, c, d + t1, e, f, g)
 
-    out = jax.lax.fori_loop(0, 64, round_fn, tuple(state))
+    out = jax.lax.fori_loop(jnp.int32(0), jnp.int32(64), round_fn, tuple(state))
     return tuple(s + v for s, v in zip(state, out))
 
 
@@ -80,7 +83,16 @@ _PAD64[15] = 512
 
 def sha256_64B_words(w16: jax.Array) -> jax.Array:
     """Batched sha256 of 64-byte messages given as (..., 16) uint32 words
-    (Merkle parent hash: left_root_words || right_root_words). -> (..., 8)."""
+    (Merkle parent hash: left_root_words || right_root_words). -> (..., 8).
+
+    GSPMD caveat: when the batch dim is SHARDED and smaller than the mesh
+    (the top levels of a sharded Merkle fold), the partitioned while-loop
+    schedule updates miscompile on the CPU backend (jax 0.4.37 logs
+    "Involuntary full rematerialization" around the loop's dynamic slices
+    and the values diverge). Keep sharded callers' batch dims either
+    >= the mesh size or replicated — tests/test_mesh_epoch.py gathers the
+    scan output before the cross-layout state-root comparison for this
+    reason."""
     state = _compress(_init_state(w16.shape[:-1]), w16)
     pad = jnp.broadcast_to(jnp.asarray(_PAD64), w16.shape[:-1] + (16,))
     state = _compress(state, pad)

@@ -26,20 +26,34 @@ from .mesh import DATA_AXIS
 from functools import lru_cache
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """Compat shim: jax >= 0.6 exposes `jax.shard_map` with the `check_vma`
+    flag; older builds (<= 0.4.x) ship `jax.experimental.shard_map` where
+    the same replication checker is called `check_rep`. Both are disabled —
+    every per-device tail here recomputes an identical replicated reduce
+    from gathered partials, which the checker can't prove."""
+    try:
+        from jax import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 @lru_cache(maxsize=8)
 def _mesh_reduce_fn(mesh):
     """One compiled reducer per mesh (jit then caches per input shape);
     rebuilding the shard_map closure per call would recompile every time."""
-    from jax import shard_map
 
     @partial(
-        shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
         out_specs=(P(), P(), P()),
-        # every device computes the identical tail reduce from the gathered
-        # partials; the varying-manual-axes checker can't prove that
-        check_vma=False,
     )
     def reduce_shards(X, Y, Z):
         px, py, pz = K.g1_sum_reduce((X, Y, Z))
@@ -97,14 +111,12 @@ def _mesh_rlc_fn(mesh, p2_is_neg_g1: bool):
     paid once, not per shard.
     """
     import jax.numpy as jnp
-    from jax import shard_map
 
     @partial(
-        shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=tuple([P(DATA_AXIS)] * 9),
         out_specs=P(),
-        check_vma=False,  # replicated tail, same stance as the G1 reduce
     )
     def rlc_shards(qx, qy, px, py, q2x, q2y, p2x, p2y, zbits):
         a1x, a1y = K.rlc_randomize_g1(px, py, zbits)

@@ -36,6 +36,10 @@ from dataclasses import dataclass, field
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 _LEN = struct.Struct("<I")
+# GOSSIP_MAX_SIZE (specs/phase0/p2p-interface.md): the largest uncompressed
+# payload a gossip message may declare — passed to snappy.decompress so a
+# crafted preamble is rejected at the protocol bound, not the 1 GiB backstop.
+MAX_MESSAGE_SIZE = 1 << 20
 
 
 def message_id(ssz_bytes: bytes) -> bytes:
@@ -56,7 +60,7 @@ def message_id_v2(topic: bytes, data: bytes) -> bytes:
 
     prefix = len(topic).to_bytes(8, "little") + topic
     try:
-        payload = decompress(data)
+        payload = decompress(data, max_len=MAX_MESSAGE_SIZE)
         domain = MESSAGE_DOMAIN_VALID_SNAPPY
     except (ValueError, IndexError):
         # The wire-format failures snappy.decompress raises (ValueError from
@@ -78,7 +82,7 @@ def encode_message(ssz_bytes: bytes) -> bytes:
 def decode_message(wire: bytes) -> bytes:
     from ..native.snappy import decompress
 
-    return decompress(wire)
+    return decompress(wire, max_len=MAX_MESSAGE_SIZE)
 
 
 # --- framing over a stream socket -------------------------------------------

@@ -58,5 +58,16 @@ def force_cpu(n_devices: int | None = None):
         if getattr(xb, "_backends", None):
             xb._clear_backends()
             xb.get_backend.cache_clear()
-        jax.config.update("jax_num_cpu_devices", n_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:
+            # jax builds without the jax_num_cpu_devices config option
+            # (<= 0.4.x): the XLA flag is the portable spelling. It is read
+            # at backend init, which the _clear_backends above guarantees
+            # is still ahead of us.
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={n_devices}"
+                ).strip()
     return jax

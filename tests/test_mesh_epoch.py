@@ -54,6 +54,58 @@ def test_mesh_epoch_bit_equal(cfg):
             assert jnp.array_equal(getattr(aux1, name), getattr(aux8, name)), name
 
 
+@pytest.mark.slow
+def test_mesh_resident_scan_and_state_root_bit_equal(cfg):
+    """The k-epoch `lax.scan` of the resident step over the sharded registry,
+    and the device state-root sweep on its output, are bit-equal to the
+    single-device run. This is the exhaustive sweep `dryrun_multichip` used
+    to carry inline (VERDICT r4 item 10) — moved here because its four extra
+    full-program compiles blew the driver's wall-clock budget on a 1-core
+    host (MULTICHIP_r05 rc=124); the dryrun now proves the sharded scan
+    against its own mesh step and leaves the cross-layout oracle to this
+    test."""
+    import numpy as np
+
+    from consensus_specs_tpu.engine.resident import _step_body
+    from consensus_specs_tpu.engine.state_root import state_root_fn
+
+    n, k = 1024, 4
+    state = synthetic_epoch_state(cfg, n=n, seed=5, epoch=100)
+    step = _step_body(cfg)
+
+    def scan_k(st):
+        return jax.lax.scan(lambda c, _: step(c), st, None, length=k)
+
+    single_out, single_aux = jax.jit(scan_k)(state)
+
+    mesh = make_mesh(jax.devices()[:8])
+    shardings = epoch_state_shardings(mesh)
+    sharded_out, sharded_aux = jax.jit(
+        scan_k, in_shardings=(shardings,), out_shardings=(shardings, None)
+    )(shard_epoch_state(state, mesh))
+
+    for name in single_out.__dataclass_fields__:
+        assert jnp.array_equal(
+            getattr(single_out, name), getattr(sharded_out, name)), name
+    for name in single_aux.__dataclass_fields__:
+        assert jnp.array_equal(
+            getattr(single_aux, name), getattr(sharded_aux, name)), name
+
+    # The state-root sweep runs on the GATHERED mesh output: a sharded
+    # Merkle fold's top levels (batch < mesh size) miscompile through the
+    # CPU GSPMD partitioner (jax 0.4.37 — see the sha256_64B_words
+    # docstring), so the cross-layout oracle here is scan-on-mesh ->
+    # gather -> root, against the single-device scan -> root.
+    static01 = np.arange(n * 16, dtype=np.uint32).reshape(n, 16)
+    gathered = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)), sharded_out)
+    roots_sharded = state_root_fn()(gathered, jnp.asarray(static01))
+    roots_single = state_root_fn()(single_out, jnp.asarray(static01))
+    for name in roots_single:
+        assert jnp.array_equal(roots_sharded[name], roots_single[name]), (
+            f"sharded device state root diverges on field {name}")
+
+
 def test_mesh_epoch_actually_sharded(cfg):
     """The output registry arrays must really live split across the 8 devices
     (guards against a silently replicated layout that would hide collective
