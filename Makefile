@@ -11,8 +11,8 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              merkle random custody_sharding
 
 .PHONY: test testall citest testfast lint pyspec generate_tests clean_vectors \
-        detect_generator_incomplete bench bench_quick graft_check native replay \
-        random_codegen coverage
+        detect_generator_incomplete bench bench_quick bench-probe graft_check \
+        native replay random_codegen coverage deposit_contract_json
 
 # Default developer loop: full suite (minimal preset, BLS stubbed where the
 # suite chooses; JAX pinned to the virtual 8-device CPU mesh by tests/conftest.py).
@@ -34,12 +34,26 @@ testfast:
 	$(PYTHON) -m pytest tests/ -x -q -k "not pairing"
 
 # Compile-check every module and spec document (the exec-based analog of the
-# reference's `make pyspec` build of eth2spec modules).
+# reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the
+# flattened per-(fork x preset) sources are ALSO written to build/specs/ and
+# the emission is proven deterministic: each file is rendered twice and the
+# two renders must be byte-identical (CI runs this same check).
 pyspec:
 	$(PYTHON) -m compileall -q consensus_specs_tpu generators tests bench.py __graft_entry__.py
 	$(PYTHON) -c "from consensus_specs_tpu.compiler import get_spec; \
 	    [get_spec(f, p) for f in ('phase0','altair','bellatrix') for p in ('minimal','mainnet')]; \
 	    print('all fork x preset spec modules compile')"
+ifeq ($(ARTIFACTS),1)
+	$(PYTHON) -c "\
+	from consensus_specs_tpu.compiler.spec_compiler import emit_spec_artifact, render_spec_source; \
+	pairs = [(f, p) for f in ('phase0','altair','bellatrix') for p in ('minimal','mainnet')]; \
+	paths = [emit_spec_artifact(f, p) for f, p in pairs]; \
+	stale = [str(pth) for (f, p), pth in zip(pairs, paths) \
+	         if pth.read_text() != render_spec_source(f, p)]; \
+	assert not stale, f'non-deterministic emission: {stale}'; \
+	print('spec artifacts (x2, byte-identical):'); \
+	[print(' ', pth) for pth in paths]"
+endif
 
 # Static gate: compile-check + AST lint (unused imports, import shadowing,
 # mutable defaults, tuple asserts, bare excepts). The reference's
@@ -91,6 +105,22 @@ bench_quick:
 	BENCH_BLS_N=512 BENCH_E2E_RESIDENT_EPOCHS=6 BENCH_KZG_BLOBS=32 \
 	BENCH_ATT_VALIDATORS=32768 BENCH_SR_VALIDATORS=262144 \
 	BENCH_E2E_VALIDATORS=1048576 $(PYTHON) bench.py
+
+# TPU-opportunistic bench loop: retry the probe until the tunnel answers,
+# then run the bench_quick lane on the device; every attempt (success or
+# probe failure) appends a provenance record to BENCH_LOCAL.json.
+# Bounded by default so CI can run it without hanging on a dead tunnel;
+# override e.g. `make bench-probe PROBE_ARGS="--max-tries 0 --interval 300"`.
+PROBE_ARGS ?= --max-tries 3 --interval 30
+bench-probe:
+	$(PYTHON) tools/bench_probe.py $(PROBE_ARGS)
+
+# Regenerate the checked-in deposit contract artifact from the in-repo
+# assembler (consensus_specs_tpu/evm/deposit_contract_asm.py). The JSON is a
+# conformance anchor: tests/test_deposit_contract_evm.py fails if it drifts.
+deposit_contract_json:
+	$(PYTHON) -m consensus_specs_tpu.evm.build
+	$(PYTHON) -m consensus_specs_tpu.evm.build --check
 
 # What the driver compile-checks: single-chip entry + 8-device CPU-mesh dry
 # run. The axon sitecustomize imports jax at interpreter start (freezing

@@ -314,6 +314,78 @@ def build_spec(fork: str, preset_name: str, config_overrides: dict | None = None
     return module
 
 
+def render_spec_source(fork: str, preset_name: str) -> str:
+    """Flatten the (fork x preset) spec into one deterministic Python source.
+
+    The reference's `make pyspec` materializes eth2spec modules on disk
+    (setup.py objects_to_spec:561-659); build_spec here execs markdown
+    in-memory instead. This renders the same composition — resolved
+    constants, frozen runtime config, then every executable block in
+    document order — as reviewable source. Determinism contract: output
+    depends only on the spec documents + preset/config yaml (constants
+    sorted, no timestamps), so two consecutive emissions are byte-identical
+    and CI can diff artifacts across commits.
+
+    The artifact documents the composition; executing it requires the
+    runtime namespace `build_spec` seeds (ssz, bls, hash, ...) — the
+    preamble records that contract.
+    """
+    forks = FORK_ORDER[: FORK_ORDER.index(fork) + 1]
+    all_constants: dict = {}
+    sections: list[tuple[str, list[str]]] = []
+    for f in forks:
+        for doc_path in FORK_DOCS[f]:
+            full = SPEC_DIR / doc_path
+            if not full.exists():
+                continue
+            doc = parse_spec_markdown(
+                full.read_text(), allow_single_letter_constants="p2p" not in doc_path
+            )
+            all_constants.update(doc.constants)
+            if doc.python_blocks:
+                sections.append((doc_path, doc.python_blocks))
+    all_constants.update(load_preset(preset_name, forks))
+    config_values = load_config(preset_name)
+
+    out: list[str] = [
+        f'"""Flattened spec artifact: fork={fork!r} preset={preset_name!r}.',
+        "",
+        "Generated by `make pyspec ARTIFACTS=1`",
+        "(consensus_specs_tpu.compiler.spec_compiler.render_spec_source).",
+        "Executable blocks are verbatim from the markdown documents listed",
+        "below and link against the names build_spec seeds (_runtime_namespace):",
+        "ssz types/ops, bls, hash, kzg, dataclass, ... Do not edit by hand.",
+        '"""',
+        "",
+        f"fork = {fork!r}",
+        f"preset_name = {preset_name!r}",
+        "",
+        "# --- constants (markdown tables, preset-overridden) ---",
+    ]
+    for name in sorted(all_constants):
+        out.append(f"{name} = {all_constants[name]!r}")
+    out += ["", "# --- runtime config (frozen at build time) ---",
+            "config = Config(**{"]
+    for name in sorted(config_values):
+        out.append(f"    {name!r}: {config_values[name]!r},")
+    out.append("})")
+    for doc_path, blocks in sections:
+        out += ["", "", f"# === {doc_path} ==="]
+        for block in blocks:
+            out += ["", block.rstrip()]
+    return "\n".join(out) + "\n"
+
+
+def emit_spec_artifact(fork: str, preset_name: str,
+                       out_dir: str | Path = "build/specs") -> Path:
+    """Write the flattened artifact to `<out_dir>/<fork>_<preset>.py`."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"{fork}_{preset_name}.py"
+    path.write_text(render_spec_source(fork, preset_name))
+    return path
+
+
 def get_spec(fork: str, preset_name: str) -> pytypes.ModuleType:
     key = (fork, preset_name)
     if key not in _SPEC_CACHE:

@@ -1,0 +1,48 @@
+"""Minimal EVM execution harness (ISSUE 2 tentpole).
+
+A dependency-free stack-machine EVM sufficient to deploy and execute the
+deposit contract bytecode shipped in solidity_deposit_contract/
+deposit_contract.json: the Solidity-0.6-era opcode subset (arithmetic,
+keccak-256, memory/storage, CALLDATA*, LOG*, REVERT, STATICCALL to the
+sha256 precompile), an ABI encoder/decoder, and a ContractHarness that
+runs transactions against persistent storage and surfaces logs and
+reverts.  The differential layer (evm/differential.py) drives randomized
+deposit sequences through both the bytecode under this interpreter and
+the straight-line Python twin (utils/deposit_contract_twin.py), closing
+the twin<->EVM trust boundary the repo previously asserted nowhere.
+
+No EVM toolchain ships in this image, so the bytecode artifact is
+assembled by evm/deposit_contract_asm.py — an independent, hand-written
+EVM-assembly implementation of deposit_contract.sol (its own storage
+walk, ABI plumbing, revert strings and event encoding), NOT a port of
+the twin.  The two implementations share only the sha256 primitive,
+exactly like the real contract and a Python client would.
+"""
+from .abi import (
+    decode_abi,
+    decode_revert_reason,
+    encode_abi,
+    encode_call,
+    event_topic,
+    function_selector,
+)
+from .contract import CallResult, ContractHarness, load_artifact
+from .interpreter import EVM, Code, ExecutionResult, EVMError
+from .keccak import keccak256
+
+__all__ = [
+    "CallResult",
+    "Code",
+    "ContractHarness",
+    "EVM",
+    "EVMError",
+    "ExecutionResult",
+    "decode_abi",
+    "decode_revert_reason",
+    "encode_abi",
+    "encode_call",
+    "event_topic",
+    "function_selector",
+    "keccak256",
+    "load_artifact",
+]

@@ -1,0 +1,123 @@
+"""Solidity ABI encoder/decoder for the deposit-contract surface.
+
+Covers the head/tail encoding the contract's functions and event use:
+static types (uintN, bytesN, bool, address) and dynamic `bytes`/`string`.
+Selectors and event topics hash through evm.keccak (hashlib's sha3 is the
+NIST-padded variant and would compute the wrong ids).
+"""
+from __future__ import annotations
+
+from .keccak import keccak256
+
+
+class ABIError(Exception):
+    pass
+
+
+def function_selector(signature: str) -> bytes:
+    return keccak256(signature.encode())[:4]
+
+
+def event_topic(signature: str) -> bytes:
+    return keccak256(signature.encode())
+
+
+def _is_dynamic(typ: str) -> bool:
+    return typ in ("bytes", "string") or typ.endswith("[]")
+
+
+def _encode_static(typ: str, value) -> bytes:
+    if typ.startswith("uint") or typ == "address":
+        value = int(value)
+        if not 0 <= value < 2**256:
+            raise ABIError(f"{typ} out of range: {value}")
+        return value.to_bytes(32, "big")
+    if typ == "bool":
+        return int(bool(value)).to_bytes(32, "big")
+    if typ.startswith("bytes"):  # bytesN, left-aligned
+        n = int(typ[5:])
+        value = bytes(value)
+        if len(value) != n:
+            raise ABIError(f"{typ} needs exactly {n} bytes, got {len(value)}")
+        return value.ljust(32, b"\x00")
+    raise ABIError(f"unsupported static type {typ!r}")
+
+
+def encode_abi(types: list[str], values: list) -> bytes:
+    """Head/tail encoding of a flat argument tuple."""
+    if len(types) != len(values):
+        raise ABIError("types/values length mismatch")
+    head_size = 32 * len(types)
+    heads: list[bytes] = []
+    tails: list[bytes] = []
+    tail_offset = head_size
+    for typ, value in zip(types, values):
+        if _is_dynamic(typ):
+            if typ not in ("bytes", "string"):
+                raise ABIError(f"unsupported dynamic type {typ!r}")
+            data = value.encode() if isinstance(value, str) else bytes(value)
+            padded = len(data).to_bytes(32, "big") + data
+            if len(data) % 32:
+                padded += b"\x00" * (32 - len(data) % 32)
+            heads.append(tail_offset.to_bytes(32, "big"))
+            tails.append(padded)
+            tail_offset += len(padded)
+        else:
+            heads.append(_encode_static(typ, value))
+    return b"".join(heads) + b"".join(tails)
+
+
+def encode_call(signature: str, values: list) -> bytes:
+    types = _parse_signature_types(signature)
+    return function_selector(signature) + encode_abi(types, values)
+
+
+def _parse_signature_types(signature: str) -> list[str]:
+    inner = signature[signature.index("(") + 1:signature.rindex(")")]
+    return [t for t in inner.split(",") if t]
+
+
+def decode_abi(types: list[str], data: bytes) -> list:
+    """Decode a flat tuple; bounds-checked so truncated blobs raise."""
+    out = []
+    for i, typ in enumerate(types):
+        head = data[32 * i:32 * i + 32]
+        if len(head) < 32:
+            raise ABIError("truncated head")
+        word = int.from_bytes(head, "big")
+        if _is_dynamic(typ):
+            if typ not in ("bytes", "string"):
+                raise ABIError(f"unsupported dynamic type {typ!r}")
+            if word + 32 > len(data):
+                raise ABIError("dynamic offset out of bounds")
+            length = int.from_bytes(data[word:word + 32], "big")
+            if word + 32 + length > len(data):
+                raise ABIError("dynamic data out of bounds")
+            raw = data[word + 32:word + 32 + length]
+            out.append(raw.decode() if typ == "string" else raw)
+        elif typ.startswith("uint") or typ == "address":
+            out.append(word)
+        elif typ == "bool":
+            out.append(bool(word))
+        elif typ.startswith("bytes"):
+            out.append(head[:int(typ[5:])])
+        else:
+            raise ABIError(f"unsupported type {typ!r}")
+    return out
+
+
+_ERROR_SELECTOR = function_selector("Error(string)")  # 0x08c379a0
+_PANIC_SELECTOR = function_selector("Panic(uint256)")  # 0x4e487b71
+
+
+def decode_revert_reason(returndata: bytes) -> str | None:
+    """Error(string) reason, Panic(uint256) code, or None for bare reverts."""
+    if len(returndata) >= 4 and returndata[:4] == _ERROR_SELECTOR:
+        try:
+            return decode_abi(["string"], returndata[4:])[0]
+        except ABIError:
+            return None
+    if len(returndata) >= 4 and returndata[:4] == _PANIC_SELECTOR:
+        code = int.from_bytes(returndata[4:36].ljust(32, b"\x00"), "big")
+        return f"Panic(0x{code:02x})"
+    return None

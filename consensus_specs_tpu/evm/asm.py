@@ -1,0 +1,127 @@
+"""Two-pass EVM assembler: mnemonic stream with labels -> bytecode.
+
+Just enough to author the deposit contract by hand
+(evm/deposit_contract_asm.py): PUSH with minimal-width immediates, label
+references as fixed-width PUSH2 (two passes converge immediately because
+every label ref has constant size), and JUMPDEST placement by name.
+Determinism matters — the assembled artifact is checked in and a test
+re-assembles it byte-for-byte — so there is no content-dependent width
+selection anywhere except the value-PUSH minimal width, which is a pure
+function of the value.
+"""
+from __future__ import annotations
+
+from .opcodes import BY_NAME
+
+
+class AsmError(Exception):
+    pass
+
+
+class _LabelRef:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class _LabelDef:
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+class Asm:
+    """Append-only instruction builder.
+
+    asm.op("ADD"); asm.push(5); asm.push_label("loop"); asm.op("JUMPI");
+    asm.label("loop") ...; code = asm.assemble()
+    """
+
+    def __init__(self):
+        self._items: list = []
+
+    # -- emission ---------------------------------------------------------
+    def op(self, *names: str) -> "Asm":
+        for name in names:
+            if name not in BY_NAME:
+                raise AsmError(f"unknown opcode {name!r}")
+            if BY_NAME[name].immediate:
+                raise AsmError(f"{name} takes an immediate; use push()")
+            self._items.append(name)
+        return self
+
+    def push(self, value: int, width: int | None = None) -> "Asm":
+        if value < 0 or value >= 2**256:
+            raise AsmError(f"push value out of range: {value}")
+        if width is None:
+            width = max(1, (value.bit_length() + 7) // 8)
+        if not 1 <= width <= 32 or value >= 2 ** (8 * width):
+            raise AsmError(f"push width {width} cannot hold {value}")
+        self._items.append((width, value))
+        return self
+
+    def push_bytes(self, data: bytes) -> "Asm":
+        if not 1 <= len(data) <= 32:
+            raise AsmError("push_bytes takes 1..32 bytes")
+        return self.push(int.from_bytes(data, "big"), width=len(data))
+
+    def push_label(self, name: str) -> "Asm":
+        self._items.append(_LabelRef(name))
+        return self
+
+    def label(self, name: str) -> "Asm":
+        """Define `name` here and emit its JUMPDEST."""
+        self._items.append(_LabelDef(name))
+        return self
+
+    def append(self, other: "Asm") -> "Asm":
+        self._items.extend(other._items)
+        return self
+
+    # -- assembly ---------------------------------------------------------
+    def _sizes(self) -> list[int]:
+        sizes = []
+        for item in self._items:
+            if isinstance(item, str):
+                sizes.append(1)
+            elif isinstance(item, tuple):
+                sizes.append(1 + item[0])
+            elif isinstance(item, _LabelRef):
+                sizes.append(3)  # PUSH2 xxxx
+            elif isinstance(item, _LabelDef):
+                sizes.append(1)  # JUMPDEST
+            else:  # pragma: no cover - builder invariant
+                raise AsmError(f"bad item {item!r}")
+        return sizes
+
+    def assemble(self) -> bytes:
+        sizes = self._sizes()
+        offsets: dict[str, int] = {}
+        pc = 0
+        for item, size in zip(self._items, sizes):
+            if isinstance(item, _LabelDef):
+                if item.name in offsets:
+                    raise AsmError(f"duplicate label {item.name!r}")
+                offsets[item.name] = pc
+            pc += size
+        out = bytearray()
+        for item in self._items:
+            if isinstance(item, str):
+                out.append(BY_NAME[item].value)
+            elif isinstance(item, tuple):
+                width, value = item
+                out.append(BY_NAME[f"PUSH{width}"].value)
+                out += value.to_bytes(width, "big")
+            elif isinstance(item, _LabelRef):
+                if item.name not in offsets:
+                    raise AsmError(f"undefined label {item.name!r}")
+                target = offsets[item.name]
+                if target >= 2**16:
+                    raise AsmError(f"label {item.name!r} beyond PUSH2 range")
+                out.append(BY_NAME["PUSH2"].value)
+                out += target.to_bytes(2, "big")
+            else:
+                out.append(BY_NAME["JUMPDEST"].value)
+        return bytes(out)

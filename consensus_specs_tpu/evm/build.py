@@ -1,0 +1,53 @@
+"""Deterministic artifact writer for the hand-assembled deposit contract.
+
+`python -m consensus_specs_tpu.evm.build` regenerates
+solidity_deposit_contract/deposit_contract.json from
+evm/deposit_contract_asm.py.  The emission is byte-stable (sorted keys,
+fixed indent, trailing newline) so the checked-in file acts as a
+conformance anchor: tests/test_deposit_contract_evm.py fails if the
+assembler output drifts from the committed bytecode.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .deposit_contract_asm import build_artifact
+
+DEFAULT_OUT = (
+    Path(__file__).resolve().parent.parent.parent
+    / "solidity_deposit_contract" / "deposit_contract.json"
+)
+
+
+def render_artifact() -> str:
+    return json.dumps(build_artifact(), indent=2, sort_keys=True) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("-o", "--output", type=Path, default=DEFAULT_OUT,
+                        help=f"output path (default: {DEFAULT_OUT})")
+    parser.add_argument("--check", action="store_true",
+                        help="do not write; exit 1 if the file on disk differs")
+    args = parser.parse_args(argv)
+
+    text = render_artifact()
+    if args.check:
+        on_disk = args.output.read_text() if args.output.exists() else None
+        if on_disk != text:
+            print(f"STALE: {args.output} does not match the assembler output "
+                  f"(run `make deposit_contract_json`)", file=sys.stderr)
+            return 1
+        print(f"OK: {args.output} matches the assembler output")
+        return 0
+    args.output.parent.mkdir(parents=True, exist_ok=True)
+    args.output.write_text(text)
+    print(f"wrote {args.output} ({len(text)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,134 @@
+"""ContractHarness: deploy creation bytecode, run transactions, keep state.
+
+The web3_tester analog for this image: persistent storage between calls,
+transaction atomicity (storage snapshot dropped on success, restored on
+revert/exceptional halt), decoded logs, and Error(string) revert reasons.
+Single-contract — exactly what the differential conformance layer needs.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .abi import decode_abi, decode_revert_reason, encode_abi, event_topic, function_selector
+from .interpreter import EVM, Code, ExecutionResult, Log
+
+
+@dataclass
+class DecodedEvent:
+    name: str
+    args: list
+
+
+@dataclass
+class CallResult:
+    success: bool
+    output: bytes = b""
+    returned: list | None = None     # ABI-decoded outputs (when abi known)
+    logs: list[Log] = field(default_factory=list)
+    events: list[DecodedEvent] = field(default_factory=list)
+    revert_reason: str | None = None  # Error(string) payload, None if bare
+    error: str | None = None          # exceptional halt description
+    steps: int = 0
+
+
+def load_artifact(path: str | Path) -> dict:
+    with open(path) as f:
+        artifact = json.load(f)
+    if "abi" not in artifact or "bytecode" not in artifact:
+        raise ValueError(f"{path}: not a contract artifact (needs abi+bytecode)")
+    return artifact
+
+
+def _sig_of(entry: dict) -> str:
+    return entry["name"] + "(" + ",".join(i["type"] for i in entry["inputs"]) + ")"
+
+
+class ContractHarness:
+    def __init__(self, abi: list[dict], creation_code: bytes, *,
+                 step_limit: int | None = None):
+        self.abi = abi
+        self.creation_code = creation_code
+        self.storage: dict[int, int] = {}
+        self.runtime: Code | None = None
+        self._step_limit = step_limit
+        self._functions: dict[str, dict] = {}
+        self._events: dict[int, dict] = {}
+        for entry in abi:
+            if entry.get("type") == "function":
+                self._functions[entry["name"]] = entry
+            elif entry.get("type") == "event":
+                topic = int.from_bytes(event_topic(_sig_of(entry)), "big")
+                self._events[topic] = entry
+
+    @classmethod
+    def from_artifact(cls, artifact: dict | str | Path, **kwargs) -> "ContractHarness":
+        if not isinstance(artifact, dict):
+            artifact = load_artifact(artifact)
+        code = bytes.fromhex(artifact["bytecode"].removeprefix("0x"))
+        return cls(artifact["abi"], code, **kwargs)
+
+    # -- lifecycle --------------------------------------------------------
+    def deploy(self, value: int = 0) -> ExecutionResult:
+        """Run the constructor; its RETURN payload becomes the runtime code."""
+        evm = self._evm(Code(self.creation_code))
+        result = evm.execute(calldata=b"", value=value)
+        if not result.success:
+            raise RuntimeError(
+                f"constructor failed: {result.error or result.output.hex()}"
+            )
+        if not result.output:
+            raise RuntimeError("constructor returned empty runtime code")
+        self.runtime = Code(result.output)
+        return result
+
+    def _evm(self, code: Code) -> EVM:
+        kwargs = {"storage": self.storage}
+        if self._step_limit is not None:
+            kwargs["step_limit"] = self._step_limit
+        return EVM(code, **kwargs)
+
+    # -- transactions -----------------------------------------------------
+    def call(self, fn: str, args: list | None = None, *, value: int = 0) -> CallResult:
+        entry = self._functions.get(fn)
+        if entry is None:
+            raise KeyError(f"function {fn!r} not in ABI")
+        sig = _sig_of(entry)
+        calldata = function_selector(sig) + encode_abi(
+            [i["type"] for i in entry["inputs"]], list(args or [])
+        )
+        result = self.raw_call(calldata, value=value)
+        if result.success and entry.get("outputs"):
+            result.returned = decode_abi(
+                [o["type"] for o in entry["outputs"]], result.output
+            )
+        return result
+
+    def raw_call(self, calldata: bytes, *, value: int = 0) -> CallResult:
+        """One transaction: storage commits on success, rolls back otherwise."""
+        if self.runtime is None:
+            raise RuntimeError("contract not deployed")
+        snapshot = dict(self.storage)
+        res = self._evm(self.runtime).execute(calldata=calldata, value=value)
+        if not res.success:
+            self.storage.clear()
+            self.storage.update(snapshot)
+            return CallResult(
+                success=False, output=res.output,
+                revert_reason=decode_revert_reason(res.output) if res.reverted else None,
+                error=res.error, steps=res.steps,
+            )
+        return CallResult(
+            success=True, output=res.output, logs=res.logs,
+            events=[self._decode_event(log) for log in res.logs],
+            steps=res.steps,
+        )
+
+    def _decode_event(self, log: Log) -> DecodedEvent:
+        entry = self._events.get(log.topics[0]) if log.topics else None
+        if entry is None:
+            return DecodedEvent(name="<unknown>", args=[log.data])
+        # non-indexed inputs live ABI-encoded in the data section
+        types = [i["type"] for i in entry["inputs"] if not i.get("indexed")]
+        return DecodedEvent(name=entry["name"], args=decode_abi(types, log.data))

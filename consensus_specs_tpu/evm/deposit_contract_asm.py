@@ -1,0 +1,510 @@
+"""The deposit contract, hand-written in EVM assembly.
+
+No solc ships in this image, so the bytecode artifact
+(solidity_deposit_contract/deposit_contract.json) is assembled here: an
+independent implementation of deposit_contract.sol at the EVM level —
+its own storage walk, calldata validation, sha256-precompile hashing,
+Error(string) reverts (byte-identical reason strings to the .sol) and
+DepositEvent ABI encoding.  It deliberately shares NO code with the
+Python twin (utils/deposit_contract_twin.py): the twin is straight-line
+Python over hashlib; this is a storage/memory/stack program executed
+opcode-by-opcode, so the differential suite (evm/differential.py)
+compares two genuinely different execution paths the way the reference
+compares web3-executed solc output against the spec.
+
+Storage layout (same as the Solidity contract):
+    slots 0..31   branch[32]
+    slot  32      deposit_count
+    slots 33..64  zero_hashes[32]
+
+Memory map (runtime, fixed scratch "registers" — the assembly keeps loop
+state in memory, not deep on the stack, so every macro is stack-neutral):
+    0x000..0x03f  64-byte sha256 input window
+    0x060..0x13f  hash intermediates (pubkey_root, sig halves, node, ...)
+    0x140..0x1ff  registers (node, size, height, amount, le64 scratch)
+    0x440..0x4ff  calldata cursors (data offset + length per bytes arg)
+    0x500..0x73f  DepositEvent ABI buffer (576 bytes, fully static layout)
+"""
+from __future__ import annotations
+
+from .abi import encode_abi, event_topic, function_selector
+from .asm import Asm
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+MAX_DEPOSIT_COUNT = 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1
+GWEI = 10**9
+MIN_DEPOSIT_WEI = 10**18
+UINT64_MAX = 2**64 - 1
+
+SLOT_COUNT = 32
+SLOT_ZERO_HASHES = 33
+
+# memory map
+IN = 0x00            # sha input window (64 bytes)
+H_PUBKEY = 0x60
+H_SIG1 = 0x80        # H_SIG1/H_SIG2 adjacent: signature_root hashes them in place
+H_SIG2 = 0xA0
+H_SIGROOT = 0xC0
+H_LEFT = 0xE0        # H_LEFT/H_RIGHT adjacent: node hashes them in place
+H_RIGHT = 0x100
+H_NODE = 0x120
+R_NODE = 0x140
+R_SIZE = 0x160
+R_HEIGHT = 0x180
+R_AMOUNT = 0x1A0
+R_LE64A = 0x1C0      # le64(deposit_amount), reused by event + DepositData hash
+R_PK_DATA = 0x440
+R_PK_LEN = 0x460
+R_WC_DATA = 0x480
+R_WC_LEN = 0x4A0
+R_SIG_DATA = 0x4C0
+R_SIG_LEN = 0x4E0
+EV = 0x500           # DepositEvent ABI buffer
+EV_SIZE = 0x240      # 5 offsets + 5 (len, padded data) pairs = 576 bytes
+
+SEL_DEPOSIT = int.from_bytes(function_selector("deposit(bytes,bytes,bytes,bytes32)"), "big")
+SEL_ROOT = int.from_bytes(function_selector("get_deposit_root()"), "big")
+SEL_COUNT = int.from_bytes(function_selector("get_deposit_count()"), "big")
+SEL_SUPPORTS = int.from_bytes(function_selector("supportsInterface(bytes4)"), "big")
+DEPOSIT_EVENT_TOPIC = int.from_bytes(
+    event_topic("DepositEvent(bytes,bytes,bytes,bytes,bytes)"), "big"
+)
+# ERC-165 ids: IERC165 and IDepositContract (xor of its three selectors)
+IID_ERC165 = 0x01FFC9A7
+IID_DEPOSIT = SEL_DEPOSIT ^ SEL_ROOT ^ SEL_COUNT
+TOP4_MASK = 0xFFFFFFFF << 224
+
+# Revert reasons, byte-identical to deposit_contract.sol
+ERR_PUBKEY = "DepositContract: invalid pubkey length"
+ERR_WC = "DepositContract: invalid withdrawal_credentials length"
+ERR_SIG = "DepositContract: invalid signature length"
+ERR_LOW = "DepositContract: deposit value too low"
+ERR_GWEI = "DepositContract: deposit value not multiple of gwei"
+ERR_HIGH = "DepositContract: deposit value too high"
+ERR_ROOT = ("DepositContract: reconstructed DepositData does not match "
+            "supplied deposit_data_root")
+ERR_FULL = "DepositContract: merkle tree full"
+ALL_REVERT_REASONS = [ERR_PUBKEY, ERR_WC, ERR_SIG, ERR_LOW, ERR_GWEI,
+                      ERR_HIGH, ERR_ROOT, ERR_FULL]
+
+
+# --- macros (each leaves the stack exactly as it found it) ----------------
+
+def _sha256(a: Asm, in_off: int, in_len: int, out_off: int) -> None:
+    """mem[out:out+32] = sha256(mem[in:in+len]) via the 0x02 precompile."""
+    a.push(32).push(out_off).push(in_len).push(in_off).push(2)
+    a.push(0xFFFFFFFF)  # gas operand (no schedule in the harness)
+    a.op("STATICCALL")
+    a.op("ISZERO").push_label("panic").op("JUMPI")
+
+
+def _mload(a: Asm, off: int) -> None:
+    a.push(off).op("MLOAD")
+
+
+def _mstore_top(a: Asm, off: int) -> None:
+    """mem[off] = pop()."""
+    a.push(off).op("MSTORE")
+
+
+def _to_le64(a: Asm) -> None:
+    """[v] -> [le64(v) as the TOP 8 bytes of a word, low 24 bytes zero].
+
+    MSTOREing the result writes the 8 little-endian bytes first, then 24
+    zero bytes — exactly `to_little_endian_64(value) ++ bytes24(0)`.
+    """
+    a.push(0)  # accumulator
+    for j in range(8):
+        a.op("DUP2")
+        if j:
+            a.push(8 * j).op("SHR")
+        a.push(0xFF).op("AND")
+        a.push(8 * (31 - j)).op("SHL")
+        a.op("OR")
+    a.op("SWAP1").op("POP")
+
+
+def _revert_msg(a: Asm, label: str, message: str) -> None:
+    """JUMPDEST `label` that reverts with Error(`message`)."""
+    a.label(label)
+    payload = function_selector("Error(string)") + encode_abi(["string"], [message])
+    for i in range(0, len(payload), 32):
+        a.push_bytes(payload[i:i + 32].ljust(32, b"\x00"))
+        _mstore_top(a, i)
+    a.push(len(payload)).push(0).op("REVERT")
+
+
+def _load_bytes_arg(a: Asm, head_off: int, data_reg: int, len_reg: int) -> None:
+    """ABI-decode one `bytes` argument: validate its head offset and length
+    against CALLDATASIZE (malformed encodings revert(0,0), as solc emits),
+    then store the calldata offset of the payload and its length."""
+    a.push(head_off).op("CALLDATALOAD")                      # [ofs]
+    a.op("DUP1").push(0xFFFFFFFF).op("LT")                   # ofs > 2^32-1 ?
+    a.push_label("fail_abi").op("JUMPI")
+    a.push(4).op("ADD")                                      # [pos]
+    a.op("DUP1").push(32).op("ADD").op("CALLDATASIZE").op("LT")  # cds < pos+32 ?
+    a.push_label("fail_abi").op("JUMPI")
+    a.op("DUP1").op("CALLDATALOAD")                          # [pos, len]
+    a.op("DUP1").push(0xFFFFFFFF).op("LT")                   # len > 2^32-1 ?
+    a.push_label("fail_abi").op("JUMPI")
+    a.op("DUP1")
+    _mstore_top(a, len_reg)                                  # [pos, len]
+    a.op("SWAP1").push(32).op("ADD")                         # [len, data]
+    a.op("DUP1")
+    _mstore_top(a, data_reg)                                 # [len, data]
+    a.op("ADD").op("CALLDATASIZE").op("LT")                  # cds < data+len ?
+    a.push_label("fail_abi").op("JUMPI")
+
+
+def _require_len(a: Asm, len_reg: int, expected: int, revert_label: str) -> None:
+    _mload(a, len_reg)
+    a.push(expected).op("EQ").op("ISZERO")
+    a.push_label(revert_label).op("JUMPI")
+
+
+def _emit_deposit_event(a: Asm) -> None:
+    """ABI-encode (pubkey, wc, le64(amount), signature, le64(count)) into the
+    static event buffer and LOG1 it.  All five members have fixed payload
+    sizes, so every offset/length word is a compile-time constant."""
+    for rel, const in [
+        (0x00, 0xA0), (0x20, 0x100), (0x40, 0x140), (0x60, 0x180), (0x80, 0x200),
+        (0xA0, 48), (0x100, 32), (0x140, 8), (0x180, 96), (0x200, 8),
+    ]:
+        a.push(const)
+        _mstore_top(a, EV + rel)
+    # pubkey payload: clear the padding word, then copy 48 bytes over its head
+    a.push(0)
+    _mstore_top(a, EV + 0xE0)
+    a.push(48)
+    _mload(a, R_PK_DATA)
+    a.push(EV + 0xC0).op("CALLDATACOPY")
+    # withdrawal_credentials payload (exactly one word)
+    a.push(32)
+    _mload(a, R_WC_DATA)
+    a.push(EV + 0x120).op("CALLDATACOPY")
+    # amount payload: le64 word (top 8 bytes data, low 24 zero)
+    _mload(a, R_LE64A)
+    _mstore_top(a, EV + 0x160)
+    # signature payload (exactly three words)
+    a.push(96)
+    _mload(a, R_SIG_DATA)
+    a.push(EV + 0x1A0).op("CALLDATACOPY")
+    # index payload: le64(deposit_count) BEFORE the increment
+    a.push(SLOT_COUNT).op("SLOAD")
+    _to_le64(a)
+    _mstore_top(a, EV + 0x220)
+    a.push(DEPOSIT_EVENT_TOPIC).push(EV_SIZE).push(EV).op("LOG1")
+
+
+# --- runtime --------------------------------------------------------------
+
+def build_runtime() -> bytes:
+    a = Asm()
+
+    # dispatcher
+    a.push(4).op("CALLDATASIZE").op("LT")        # cds < 4: no selector
+    a.push_label("fail_abi").op("JUMPI")
+    a.push(0).op("CALLDATALOAD").push(224).op("SHR")
+    for sel, label in [(SEL_DEPOSIT, "fn_deposit"), (SEL_ROOT, "fn_root"),
+                       (SEL_COUNT, "fn_count"), (SEL_SUPPORTS, "fn_supports")]:
+        a.op("DUP1").push(sel).op("EQ").push_label(label).op("JUMPI")
+    a.label("fail_abi")
+    a.push(0).push(0).op("REVERT")
+
+    # --- deposit(bytes,bytes,bytes,bytes32) ------------------------------
+    a.label("fn_deposit").op("POP")
+    a.push(132).op("CALLDATASIZE").op("LT")      # head: 3 offsets + bytes32
+    a.push_label("fail_abi").op("JUMPI")
+    _load_bytes_arg(a, 4, R_PK_DATA, R_PK_LEN)
+    _load_bytes_arg(a, 36, R_WC_DATA, R_WC_LEN)
+    _load_bytes_arg(a, 68, R_SIG_DATA, R_SIG_LEN)
+    _require_len(a, R_PK_LEN, 48, "rev_pubkey")
+    _require_len(a, R_WC_LEN, 32, "rev_wc")
+    _require_len(a, R_SIG_LEN, 96, "rev_sig")
+
+    # value gates
+    a.op("CALLVALUE").push(MIN_DEPOSIT_WEI).op("GT")    # 1 ether > value ?
+    a.push_label("rev_low").op("JUMPI")
+    a.push(GWEI).op("CALLVALUE").op("MOD")              # value % 1 gwei
+    a.push_label("rev_gwei").op("JUMPI")
+    a.push(GWEI).op("CALLVALUE").op("DIV")              # amount = value / 1 gwei
+    a.op("DUP1")
+    _mstore_top(a, R_AMOUNT)
+    a.push(UINT64_MAX).op("SWAP1").op("GT")             # amount > 2^64-1 ?
+    a.push_label("rev_high").op("JUMPI")
+
+    # le64(amount): needed by both the event and the DepositData chunk
+    _mload(a, R_AMOUNT)
+    _to_le64(a)
+    _mstore_top(a, R_LE64A)
+
+    _emit_deposit_event(a)
+
+    # pubkey_root = sha256(pubkey ++ bytes16(0))
+    a.push(0)
+    _mstore_top(a, IN + 0x30)                   # clear padding before the copy
+    a.push(48)
+    _mload(a, R_PK_DATA)
+    a.push(IN).op("CALLDATACOPY")
+    _sha256(a, IN, 64, H_PUBKEY)
+    # sha256(signature[0:64])
+    a.push(64)
+    _mload(a, R_SIG_DATA)
+    a.push(IN).op("CALLDATACOPY")
+    _sha256(a, IN, 64, H_SIG1)
+    # sha256(signature[64:96] ++ bytes32(0))
+    a.push(0)
+    _mstore_top(a, IN + 0x20)
+    a.push(32)
+    _mload(a, R_SIG_DATA)
+    a.push(64).op("ADD")
+    a.push(IN).op("CALLDATACOPY")
+    _sha256(a, IN, 64, H_SIG2)
+    # signature_root = sha256(H_SIG1 ++ H_SIG2): adjacent in memory
+    _sha256(a, H_SIG1, 64, H_SIGROOT)
+    # left = sha256(pubkey_root ++ withdrawal_credentials)
+    _mload(a, H_PUBKEY)
+    _mstore_top(a, IN)
+    a.push(32)
+    _mload(a, R_WC_DATA)
+    a.push(IN + 0x20).op("CALLDATACOPY")
+    _sha256(a, IN, 64, H_LEFT)
+    # right = sha256(le64(amount) ++ bytes24(0) ++ signature_root)
+    _mload(a, R_LE64A)
+    _mstore_top(a, IN)
+    _mload(a, H_SIGROOT)
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, H_RIGHT)
+    # node = sha256(left ++ right): adjacent in memory
+    _sha256(a, H_LEFT, 64, H_NODE)
+
+    # require node == deposit_data_root (4th argument, static, head word 4)
+    _mload(a, H_NODE)
+    a.push(100).op("CALLDATALOAD").op("EQ").op("ISZERO")
+    a.push_label("rev_root").op("JUMPI")
+
+    # require deposit_count < MAX_DEPOSIT_COUNT
+    a.push(SLOT_COUNT).op("SLOAD").push(MAX_DEPOSIT_COUNT).op("GT").op("ISZERO")
+    a.push_label("rev_full").op("JUMPI")
+
+    # deposit_count += 1; size = new count; node register = node; height = 0
+    a.push(SLOT_COUNT).op("SLOAD").push(1).op("ADD").op("DUP1")
+    _mstore_top(a, R_SIZE)
+    a.push(SLOT_COUNT).op("SSTORE")
+    _mload(a, H_NODE)
+    _mstore_top(a, R_NODE)
+    a.push(0)
+    _mstore_top(a, R_HEIGHT)
+
+    # incremental insert: while height < 32
+    a.label("ins_loop")
+    _mload(a, R_HEIGHT)
+    a.push(32).op("GT").op("ISZERO")            # 32 > height is the stay-condition
+    a.push_label("panic").op("JUMPI")           # unreachable: count < 2^32 - 1
+    _mload(a, R_SIZE)
+    a.push(1).op("AND")
+    a.push_label("ins_store").op("JUMPI")
+    # node = sha256(branch[height] ++ node)
+    _mload(a, R_HEIGHT)
+    a.op("SLOAD")
+    _mstore_top(a, IN)
+    _mload(a, R_NODE)
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, R_NODE)
+    # size >>= 1; height += 1
+    _mload(a, R_SIZE)
+    a.push(1).op("SHR")
+    _mstore_top(a, R_SIZE)
+    _mload(a, R_HEIGHT)
+    a.push(1).op("ADD")
+    _mstore_top(a, R_HEIGHT)
+    a.push_label("ins_loop").op("JUMP")
+    a.label("ins_store")                        # branch[height] = node; return
+    _mload(a, R_NODE)
+    _mload(a, R_HEIGHT)
+    a.op("SSTORE").op("STOP")
+
+    # --- get_deposit_root() ----------------------------------------------
+    a.label("fn_root").op("POP")
+    a.op("CALLVALUE").push_label("fail_abi").op("JUMPI")   # view: nonpayable
+    a.push(0)
+    _mstore_top(a, R_NODE)
+    a.push(SLOT_COUNT).op("SLOAD")
+    _mstore_top(a, R_SIZE)
+    a.push(0)
+    _mstore_top(a, R_HEIGHT)
+    a.label("root_loop")
+    _mload(a, R_HEIGHT)
+    a.push(32).op("GT").op("ISZERO")
+    a.push_label("root_done").op("JUMPI")
+    _mload(a, R_SIZE)
+    a.push(1).op("AND")
+    a.push_label("root_odd").op("JUMPI")
+    # even: node = sha256(node ++ zero_hashes[height])
+    _mload(a, R_NODE)
+    _mstore_top(a, IN)
+    _mload(a, R_HEIGHT)
+    a.push(SLOT_ZERO_HASHES).op("ADD").op("SLOAD")
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, R_NODE)
+    a.push_label("root_next").op("JUMP")
+    a.label("root_odd")                          # node = sha256(branch[h] ++ node)
+    _mload(a, R_HEIGHT)
+    a.op("SLOAD")
+    _mstore_top(a, IN)
+    _mload(a, R_NODE)
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, R_NODE)
+    a.label("root_next")
+    _mload(a, R_SIZE)
+    a.push(1).op("SHR")
+    _mstore_top(a, R_SIZE)
+    _mload(a, R_HEIGHT)
+    a.push(1).op("ADD")
+    _mstore_top(a, R_HEIGHT)
+    a.push_label("root_loop").op("JUMP")
+    a.label("root_done")                         # mix in the deposit count
+    _mload(a, R_NODE)
+    _mstore_top(a, IN)
+    a.push(SLOT_COUNT).op("SLOAD")
+    _to_le64(a)
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, IN)
+    a.push(32).push(IN).op("RETURN")
+
+    # --- get_deposit_count() ---------------------------------------------
+    a.label("fn_count").op("POP")
+    a.op("CALLVALUE").push_label("fail_abi").op("JUMPI")
+    a.push(0x20)
+    _mstore_top(a, 0x00)                         # ABI: offset
+    a.push(8)
+    _mstore_top(a, 0x20)                         # ABI: length
+    a.push(SLOT_COUNT).op("SLOAD")
+    _to_le64(a)
+    _mstore_top(a, 0x40)                         # payload (le64 ++ pad)
+    a.push(0x60).push(0).op("RETURN")
+
+    # --- supportsInterface(bytes4) ---------------------------------------
+    a.label("fn_supports").op("POP")
+    a.op("CALLVALUE").push_label("fail_abi").op("JUMPI")
+    a.push(36).op("CALLDATASIZE").op("LT")
+    a.push_label("fail_abi").op("JUMPI")
+    a.push(4).op("CALLDATALOAD").push(TOP4_MASK).op("AND")
+    a.op("DUP1").push(IID_ERC165 << 224).op("EQ")
+    a.op("SWAP1").push(IID_DEPOSIT << 224).op("EQ").op("OR")
+    _mstore_top(a, 0x00)
+    a.push(0x20).push(0).op("RETURN")
+
+    # --- revert strings + panic ------------------------------------------
+    for label, message in [
+        ("rev_pubkey", ERR_PUBKEY), ("rev_wc", ERR_WC), ("rev_sig", ERR_SIG),
+        ("rev_low", ERR_LOW), ("rev_gwei", ERR_GWEI), ("rev_high", ERR_HIGH),
+        ("rev_root", ERR_ROOT), ("rev_full", ERR_FULL),
+    ]:
+        _revert_msg(a, label, message)
+    a.label("panic").op("INVALID")
+
+    return a.assemble()
+
+
+def build_creation_code() -> bytes:
+    """Creation bytecode: constructor || runtime payload.
+
+    The constructor needs the payload's code offset, which is its own
+    length — assemble once with a placeholder, then with the real value
+    (both are fixed-width PUSH2, so the length cannot shift)."""
+    runtime = build_runtime()
+    probe = _build_constructor(runtime, 0)
+    ctor = _build_constructor(runtime, len(probe))
+    assert len(ctor) == len(probe), "constructor size must be offset-independent"
+    return ctor + runtime
+
+
+def _build_constructor(runtime: bytes, code_offset: int) -> bytes:
+    """Constructor: seed the zero_hashes ladder in storage, return runtime."""
+    a = Asm()
+    a.push(0)
+    _mstore_top(a, R_HEIGHT)
+    a.label("c_loop")
+    _mload(a, R_HEIGHT)
+    a.push(DEPOSIT_CONTRACT_TREE_DEPTH - 1).op("GT").op("ISZERO")
+    a.push_label("c_done").op("JUMPI")
+    _mload(a, R_HEIGHT)
+    a.push(SLOT_ZERO_HASHES).op("ADD").op("SLOAD").op("DUP1")
+    _mstore_top(a, IN)
+    _mstore_top(a, IN + 0x20)
+    _sha256(a, IN, 64, 0x40)
+    _mload(a, 0x40)
+    _mload(a, R_HEIGHT)
+    a.push(SLOT_ZERO_HASHES + 1).op("ADD").op("SSTORE")
+    _mload(a, R_HEIGHT)
+    a.push(1).op("ADD")
+    _mstore_top(a, R_HEIGHT)
+    a.push_label("c_loop").op("JUMP")
+    a.label("c_done")
+    a.push(len(runtime), width=2)
+    a.push(code_offset, width=2)
+    a.push(0).op("CODECOPY")
+    a.push(len(runtime), width=2)
+    a.push(0).op("RETURN")
+    a.label("panic").op("INVALID")
+    return a.assemble()
+
+
+ABI = [
+    {"type": "constructor", "inputs": [], "stateMutability": "nonpayable"},
+    {
+        "type": "event", "name": "DepositEvent", "anonymous": False,
+        "inputs": [
+            {"name": "pubkey", "type": "bytes", "indexed": False},
+            {"name": "withdrawal_credentials", "type": "bytes", "indexed": False},
+            {"name": "amount", "type": "bytes", "indexed": False},
+            {"name": "signature", "type": "bytes", "indexed": False},
+            {"name": "index", "type": "bytes", "indexed": False},
+        ],
+    },
+    {
+        "type": "function", "name": "deposit", "stateMutability": "payable",
+        "inputs": [
+            {"name": "pubkey", "type": "bytes"},
+            {"name": "withdrawal_credentials", "type": "bytes"},
+            {"name": "signature", "type": "bytes"},
+            {"name": "deposit_data_root", "type": "bytes32"},
+        ],
+        "outputs": [],
+    },
+    {
+        "type": "function", "name": "get_deposit_count", "stateMutability": "view",
+        "inputs": [], "outputs": [{"name": "", "type": "bytes"}],
+    },
+    {
+        "type": "function", "name": "get_deposit_root", "stateMutability": "view",
+        "inputs": [], "outputs": [{"name": "", "type": "bytes32"}],
+    },
+    {
+        "type": "function", "name": "supportsInterface", "stateMutability": "pure",
+        "inputs": [{"name": "interfaceId", "type": "bytes4"}],
+        "outputs": [{"name": "", "type": "bool"}],
+    },
+]
+
+
+def build_artifact() -> dict:
+    """The deposit_contract.json payload: deterministic by construction
+    (pure function of this module's source — no timestamps, no paths)."""
+    runtime = build_runtime()
+    creation = build_creation_code()
+    return {
+        "contractName": "DepositContract",
+        "abi": ABI,
+        "bytecode": "0x" + creation.hex(),
+        "deployedBytecode": "0x" + runtime.hex(),
+        "compiler": {
+            "name": "consensus_specs_tpu.evm.deposit_contract_asm",
+            "note": (
+                "hand-assembled EVM implementation of "
+                "solidity_deposit_contract/deposit_contract.sol (no solc in "
+                "this image); regenerate with `make deposit_contract_json`"
+            ),
+        },
+    }

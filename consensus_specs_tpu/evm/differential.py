@@ -1,0 +1,254 @@
+"""Twin <-> EVM differential conformance driver.
+
+Runs randomized deposit sequences through two independent implementations:
+
+  * the deposit contract BYTECODE (solidity_deposit_contract/
+    deposit_contract.json, assembled by evm/deposit_contract_asm.py)
+    executed opcode-by-opcode under evm/interpreter.py, and
+  * the straight-line Python twin (utils/deposit_contract_twin.py),
+
+asserting after every transaction that the two agree on deposit root,
+deposit count, emitted DepositEvent payloads, and revert-for-revert
+behaviour INCLUDING the exact Error(string) reason.  Scenario classes
+cover the adversarial surface the reference's web3_tester exercises:
+valid deposits, malformed argument lengths, wrong deposit_data_root,
+value underflow / not-multiple-of-gwei / uint64 overflow, the tree-full
+boundary (reached by teleporting both implementations' deposit_count to
+MAX-1 — 2^32-1 real inserts is not a test), and raw garbage calldata
+(EVM-only: the twin has no ABI surface; asserted state-neutral instead).
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from hashlib import sha256 as _sha256
+from pathlib import Path
+
+from ..utils.deposit_contract_twin import (
+    DepositContractTwin,
+    DepositRevert,
+    GWEI,
+    MAX_DEPOSIT_COUNT,
+)
+from .contract import ContractHarness, load_artifact
+from .deposit_contract_asm import SLOT_COUNT, build_artifact
+
+ARTIFACT_PATH = (
+    Path(__file__).resolve().parent.parent.parent
+    / "solidity_deposit_contract" / "deposit_contract.json"
+)
+
+SCENARIOS = [
+    # (name, weight)
+    ("valid", 10),
+    ("wrong_root", 2),
+    ("bad_pubkey_len", 1),
+    ("bad_wc_len", 1),
+    ("bad_sig_len", 1),
+    ("value_too_low", 1),
+    ("value_not_gwei", 1),
+    ("value_too_high", 1),
+    ("tree_full", 1),
+    ("garbage_calldata", 1),
+]
+
+
+def _le64(v: int) -> bytes:
+    return v.to_bytes(8, "little")
+
+
+def deposit_data_root(pubkey: bytes, wc: bytes, sig: bytes, amount_gwei: int) -> bytes:
+    """hash_tree_root(DepositData) the way both implementations reconstruct
+    it (input generation only — each side still recomputes independently)."""
+    pubkey_root = _sha256(pubkey + b"\x00" * 16).digest()
+    sig_root = _sha256(
+        _sha256(sig[:64]).digest() + _sha256(sig[64:] + b"\x00" * 32).digest()
+    ).digest()
+    return _sha256(
+        _sha256(pubkey_root + wc).digest()
+        + _sha256(_le64(amount_gwei) + b"\x00" * 24 + sig_root).digest()
+    ).digest()
+
+
+@dataclass
+class Divergence:
+    tx: int
+    scenario: str
+    kind: str
+    detail: str
+
+
+@dataclass
+class Report:
+    transactions: int = 0
+    scenario_counts: dict = field(default_factory=dict)
+    reverts: int = 0
+    divergences: list[Divergence] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences
+
+
+class DifferentialRunner:
+    def __init__(self, seed: int = 0, artifact: dict | None = None):
+        self.rng = random.Random(seed)
+        self.artifact = artifact if artifact is not None else (
+            load_artifact(ARTIFACT_PATH) if ARTIFACT_PATH.exists() else build_artifact()
+        )
+        self._fresh_pair()
+
+    def _fresh_pair(self) -> None:
+        self.harness = ContractHarness.from_artifact(self.artifact)
+        self.harness.deploy()
+        self.twin = DepositContractTwin()
+
+    # -- input generation --------------------------------------------------
+    def _args(self, pk_len=48, wc_len=32, sig_len=96):
+        rng = self.rng
+        pk = rng.randbytes(pk_len)
+        wc = rng.randbytes(wc_len)
+        sig = rng.randbytes(sig_len)
+        amount = rng.choice([
+            1 * 10**9,                       # minimum
+            32 * 10**9,                      # MAX_EFFECTIVE_BALANCE
+            rng.randrange(10**9, 2**64),     # anything
+            2**64 - 1,                       # ceiling
+        ])
+        return pk, wc, sig, amount
+
+    # -- one transaction through both implementations ----------------------
+    def step(self, index: int, report: Report) -> None:
+        scenario = self.rng.choices(
+            [s for s, _ in SCENARIOS], weights=[w for _, w in SCENARIOS]
+        )[0]
+        report.scenario_counts[scenario] = report.scenario_counts.get(scenario, 0) + 1
+
+        if scenario == "garbage_calldata":
+            self._step_garbage(index, scenario, report)
+            return
+        if scenario == "tree_full":
+            # teleport both implementations to one-below-full; the next
+            # valid deposit fills the last slot, the one after must revert
+            self.harness.storage[SLOT_COUNT] = MAX_DEPOSIT_COUNT - 1
+            self.twin.deposit_count = MAX_DEPOSIT_COUNT - 1
+
+        pk, wc, sig, amount = self._args()
+        value = amount * GWEI
+        root = deposit_data_root(pk, wc, sig, amount)
+        if scenario == "wrong_root":
+            root = self.rng.randbytes(32)
+        elif scenario == "bad_pubkey_len":
+            pk = self.rng.randbytes(self.rng.choice([0, 1, 47, 49, 96]))
+        elif scenario == "bad_wc_len":
+            wc = self.rng.randbytes(self.rng.choice([0, 31, 33, 64]))
+        elif scenario == "bad_sig_len":
+            sig = self.rng.randbytes(self.rng.choice([0, 64, 95, 97, 192]))
+        elif scenario == "value_too_low":
+            value = self.rng.choice([0, 1, GWEI, 10**18 - GWEI])
+        elif scenario == "value_not_gwei":
+            value = value + self.rng.randrange(1, GWEI)
+        elif scenario == "value_too_high":
+            value = (2**64 + self.rng.randrange(0, 2**32)) * GWEI
+
+        self._compare_tx(index, scenario, report, pk, wc, sig, root, value)
+        if scenario == "tree_full":
+            # fill the final slot, then require "merkle tree full" agreement
+            pk, wc, sig, amount = self._args()
+            root = deposit_data_root(pk, wc, sig, amount)
+            self._compare_tx(index, scenario, report, pk, wc, sig, root, amount * GWEI)
+            self._fresh_pair()  # a full tree rejects everything; reset
+
+    def _compare_tx(self, index, scenario, report, pk, wc, sig, root, value):
+        report.transactions += 1
+        res = self.harness.call("deposit", [pk, wc, sig, root], value=value)
+        twin_ok, twin_reason = True, None
+        try:
+            self.twin.deposit(pk, wc, sig, root, msg_value=value)
+        except DepositRevert as exc:
+            twin_ok, twin_reason = False, exc.reason
+
+        if res.error is not None:
+            report.divergences.append(Divergence(
+                index, scenario, "exceptional_halt", res.error))
+            return
+        if res.success != twin_ok:
+            report.divergences.append(Divergence(
+                index, scenario, "accept_reject_mismatch",
+                f"evm={'ok' if res.success else res.revert_reason!r} "
+                f"twin={'ok' if twin_ok else twin_reason!r}"))
+            return
+        if not res.success:
+            report.reverts += 1
+            if res.revert_reason != twin_reason:
+                report.divergences.append(Divergence(
+                    index, scenario, "revert_reason_mismatch",
+                    f"evm={res.revert_reason!r} twin={twin_reason!r}"))
+            return
+        # success on both: event payloads must agree
+        if len(res.events) != 1 or res.events[0].name != "DepositEvent":
+            report.divergences.append(Divergence(
+                index, scenario, "event_shape_mismatch", repr(res.events)))
+            return
+        te = self.twin.events[-1]
+        expected = [te["pubkey"], te["withdrawal_credentials"], te["amount"],
+                    te["signature"], te["index"]]
+        if res.events[0].args != expected:
+            report.divergences.append(Divergence(
+                index, scenario, "event_payload_mismatch",
+                f"evm={res.events[0].args!r} twin={expected!r}"))
+        self._check_state(index, scenario, report)
+
+    def _check_state(self, index, scenario, report):
+        root_res = self.harness.call("get_deposit_root")
+        count_res = self.harness.call("get_deposit_count")
+        if not (root_res.success and count_res.success):
+            report.divergences.append(Divergence(
+                index, scenario, "view_call_failed",
+                f"root={root_res.error} count={count_res.error}"))
+            return
+        if bytes(root_res.returned[0]) != self.twin.get_deposit_root():
+            report.divergences.append(Divergence(
+                index, scenario, "root_mismatch",
+                f"evm={bytes(root_res.returned[0]).hex()} "
+                f"twin={self.twin.get_deposit_root().hex()}"))
+        if count_res.returned[0] != self.twin.get_deposit_count():
+            report.divergences.append(Divergence(
+                index, scenario, "count_mismatch",
+                f"evm={count_res.returned[0]!r} "
+                f"twin={self.twin.get_deposit_count()!r}"))
+
+    def _step_garbage(self, index, scenario, report) -> None:
+        """Raw calldata fuzz: any outcome is fine except an exceptional halt
+        or a state change (the twin has no ABI layer to mirror)."""
+        report.transactions += 1
+        rng = self.rng
+        blob = rng.randbytes(rng.randrange(0, 200))
+        if rng.random() < 0.5:  # half the time, target the deposit selector
+            blob = bytes.fromhex("22895118") + blob
+        pre_count = self.harness.storage.get(SLOT_COUNT, 0)
+        res = self.harness.raw_call(blob, value=rng.choice([0, 10**18]))
+        if res.error is not None:
+            report.divergences.append(Divergence(
+                index, scenario, "exceptional_halt", res.error))
+        if res.success:
+            # only a view/supportsInterface selector prefix can succeed, and
+            # never with a state change
+            if self.harness.storage.get(SLOT_COUNT, 0) != pre_count:
+                report.divergences.append(Divergence(
+                    index, scenario, "state_change_on_garbage", blob.hex()))
+        else:
+            report.reverts += 1
+        self._check_state(index, scenario, report)
+
+    def run(self, n: int) -> Report:
+        report = Report()
+        i = 0
+        while report.transactions < n:
+            self.step(i, report)
+            i += 1
+        return report
+
+
+def run_differential(n: int = 1000, seed: int = 0) -> Report:
+    return DifferentialRunner(seed=seed).run(n)

@@ -1,0 +1,81 @@
+"""Pure-Python keccak-256 (the pre-NIST-padding Keccak Ethereum uses).
+
+hashlib ships sha3_256 with the 0x06 NIST domain byte — Ethereum's
+keccak-256 pads with 0x01, so the stdlib digest is NOT usable here and no
+pysha3/pycryptodome is baked into this image.  This is the plain
+Keccak-f[1600] sponge (rate 136) against the FIPS-202 draft the EVM froze:
+selectors, event topics, and the SHA3 opcode all hash through this module.
+Pinned by known-answer vectors in tests/test_evm_interpreter.py (empty
+string, "abc", and the mainnet DepositEvent topic).
+"""
+from __future__ import annotations
+
+_MASK = (1 << 64) - 1
+
+# iota round constants, 24 rounds of Keccak-f[1600]
+_RC = [
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+]
+
+# rho rotation offsets, indexed [x + 5*y] (lane (x, y))
+_ROT = [
+    0, 1, 62, 28, 27,
+    36, 44, 6, 55, 20,
+    3, 10, 43, 25, 39,
+    41, 45, 15, 21, 8,
+    18, 2, 61, 56, 14,
+]
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (64 - n))) & _MASK
+
+
+def _keccak_f1600(a: list[int]) -> None:
+    """24-round permutation over 25 lanes, in place."""
+    for rc in _RC:
+        # theta
+        c = [a[x] ^ a[x + 5] ^ a[x + 10] ^ a[x + 15] ^ a[x + 20] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            dx = d[x]
+            for y in range(0, 25, 5):
+                a[x + y] ^= dx
+        # rho + pi: b[y + 5*((2x+3y)%5)] = rotl(a[x + 5y], rot[x + 5y])
+        b = [0] * 25
+        for x in range(5):
+            for y in range(5):
+                b[y + 5 * ((2 * x + 3 * y) % 5)] = _rotl(a[x + 5 * y], _ROT[x + 5 * y])
+        # chi
+        for y in range(0, 25, 5):
+            row = b[y:y + 5]
+            for x in range(5):
+                a[x + y] = row[x] ^ ((~row[(x + 1) % 5]) & row[(x + 2) % 5] & _MASK)
+        # iota
+        a[0] ^= rc
+
+
+_RATE = 136  # 1088-bit rate for 256-bit output
+
+
+def keccak256(data: bytes) -> bytes:
+    state = [0] * 25
+    # absorb with multi-rate padding 0x01 .. 0x80 (NOT sha3's 0x06)
+    padded = bytearray(data)
+    pad_len = _RATE - (len(padded) % _RATE)
+    padded += b"\x01" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 else b"\x81"
+    for block in range(0, len(padded), _RATE):
+        for lane in range(_RATE // 8):
+            state[lane] ^= int.from_bytes(
+                padded[block + 8 * lane:block + 8 * lane + 8], "little"
+            )
+        _keccak_f1600(state)
+    # squeeze 32 bytes (rate > 32: one squeeze)
+    return b"".join(state[i].to_bytes(8, "little") for i in range(4))

@@ -1,0 +1,99 @@
+"""EVM opcode table: the Solidity-0.6-era (Constantinople/Istanbul) subset.
+
+One row per opcode: mnemonic, byte value, stack pops, stack pushes,
+immediate size (PUSHn only).  The interpreter dispatches on this table and
+the assembler inverts it; keeping both against one source of truth means a
+mnemonic typo fails assembly instead of silently executing INVALID.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class OpInfo(NamedTuple):
+    name: str
+    value: int
+    pops: int
+    pushes: int
+    immediate: int = 0  # trailing immediate bytes (PUSHn)
+
+
+_TABLE: list[OpInfo] = [
+    OpInfo("STOP", 0x00, 0, 0),
+    OpInfo("ADD", 0x01, 2, 1),
+    OpInfo("MUL", 0x02, 2, 1),
+    OpInfo("SUB", 0x03, 2, 1),
+    OpInfo("DIV", 0x04, 2, 1),
+    OpInfo("SDIV", 0x05, 2, 1),
+    OpInfo("MOD", 0x06, 2, 1),
+    OpInfo("SMOD", 0x07, 2, 1),
+    OpInfo("ADDMOD", 0x08, 3, 1),
+    OpInfo("MULMOD", 0x09, 3, 1),
+    OpInfo("EXP", 0x0A, 2, 1),
+    OpInfo("SIGNEXTEND", 0x0B, 2, 1),
+    OpInfo("LT", 0x10, 2, 1),
+    OpInfo("GT", 0x11, 2, 1),
+    OpInfo("SLT", 0x12, 2, 1),
+    OpInfo("SGT", 0x13, 2, 1),
+    OpInfo("EQ", 0x14, 2, 1),
+    OpInfo("ISZERO", 0x15, 1, 1),
+    OpInfo("AND", 0x16, 2, 1),
+    OpInfo("OR", 0x17, 2, 1),
+    OpInfo("XOR", 0x18, 2, 1),
+    OpInfo("NOT", 0x19, 1, 1),
+    OpInfo("BYTE", 0x1A, 2, 1),
+    OpInfo("SHL", 0x1B, 2, 1),
+    OpInfo("SHR", 0x1C, 2, 1),
+    OpInfo("SAR", 0x1D, 2, 1),
+    OpInfo("SHA3", 0x20, 2, 1),  # keccak-256 (the opcode kept its 2014 name)
+    OpInfo("ADDRESS", 0x30, 0, 1),
+    OpInfo("BALANCE", 0x31, 1, 1),
+    OpInfo("ORIGIN", 0x32, 0, 1),
+    OpInfo("CALLER", 0x33, 0, 1),
+    OpInfo("CALLVALUE", 0x34, 0, 1),
+    OpInfo("CALLDATALOAD", 0x35, 1, 1),
+    OpInfo("CALLDATASIZE", 0x36, 0, 1),
+    OpInfo("CALLDATACOPY", 0x37, 3, 0),
+    OpInfo("CODESIZE", 0x38, 0, 1),
+    OpInfo("CODECOPY", 0x39, 3, 0),
+    OpInfo("GASPRICE", 0x3A, 0, 1),
+    OpInfo("RETURNDATASIZE", 0x3D, 0, 1),
+    OpInfo("RETURNDATACOPY", 0x3E, 3, 0),
+    OpInfo("BLOCKHASH", 0x40, 1, 1),
+    OpInfo("COINBASE", 0x41, 0, 1),
+    OpInfo("TIMESTAMP", 0x42, 0, 1),
+    OpInfo("NUMBER", 0x43, 0, 1),
+    OpInfo("DIFFICULTY", 0x44, 0, 1),
+    OpInfo("GASLIMIT", 0x45, 0, 1),
+    OpInfo("CHAINID", 0x46, 0, 1),
+    OpInfo("SELFBALANCE", 0x47, 0, 1),
+    OpInfo("POP", 0x50, 1, 0),
+    OpInfo("MLOAD", 0x51, 1, 1),
+    OpInfo("MSTORE", 0x52, 2, 0),
+    OpInfo("MSTORE8", 0x53, 2, 0),
+    OpInfo("SLOAD", 0x54, 1, 1),
+    OpInfo("SSTORE", 0x55, 2, 0),
+    OpInfo("JUMP", 0x56, 1, 0),
+    OpInfo("JUMPI", 0x57, 2, 0),
+    OpInfo("PC", 0x58, 0, 1),
+    OpInfo("MSIZE", 0x59, 0, 1),
+    OpInfo("GAS", 0x5A, 0, 1),
+    OpInfo("JUMPDEST", 0x5B, 0, 0),
+    OpInfo("RETURN", 0xF3, 2, 0),
+    OpInfo("STATICCALL", 0xFA, 6, 1),
+    OpInfo("REVERT", 0xFD, 2, 0),
+    OpInfo("INVALID", 0xFE, 0, 0),
+]
+
+for _n in range(1, 33):
+    _TABLE.append(OpInfo(f"PUSH{_n}", 0x60 + _n - 1, 0, 1, immediate=_n))
+for _n in range(1, 17):
+    _TABLE.append(OpInfo(f"DUP{_n}", 0x80 + _n - 1, _n, _n + 1))
+    _TABLE.append(OpInfo(f"SWAP{_n}", 0x90 + _n - 1, _n + 1, _n + 1))
+for _n in range(0, 5):
+    _TABLE.append(OpInfo(f"LOG{_n}", 0xA0 + _n, 2 + _n, 0))
+
+BY_NAME: dict[str, OpInfo] = {op.name: op for op in _TABLE}
+BY_VALUE: dict[int, OpInfo] = {op.value: op for op in _TABLE}
+
+STACK_LIMIT = 1024

@@ -430,6 +430,79 @@ def test_deposit_eth1_withdrawal_credentials(spec, state):
     assert bytes(state.validators[new_index].withdrawal_credentials)[:1] == b"\x01"
 
 
+# --- adversarial deposit inputs (test_process_deposit.py invalid_* ) ---------
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_invalid_merkle_proof(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # one corrupted branch node breaks is_valid_merkle_branch at depth 33
+    deposit = build_deposit_for_index(spec, state, len(state.validators))
+    node = bytearray(bytes(deposit.proof[3]))
+    node[0] ^= 0xFF
+    deposit.proof[3] = spec.Bytes32(bytes(node))
+    yield from _run_op(spec, state, "deposit", deposit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_wrong_deposit_index(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # proof was built for index eth1_deposit_index; verifying the same
+    # branch at index+1 walks the wrong left/right sequence
+    deposit = build_deposit_for_index(spec, state, len(state.validators))
+    state.eth1_deposit_index += 1
+    yield from _run_op(spec, state, "deposit", deposit, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_wrong_deposit_root(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # valid branch, but the state commits to a different contract root
+    deposit = build_deposit_for_index(spec, state, len(state.validators))
+    state.eth1_data.deposit_root = spec.Root(b"\x42" * 32)
+    yield from _run_op(spec, state, "deposit", deposit, valid=False)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_deposit_invalid_sig_new_validator_is_noop(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # a NEW deposit with a bad proof-of-possession is consumed without
+    # assertion but must not create the validator (apply_deposit returns
+    # early after the signature check fails)
+    new_index = len(state.validators)
+    deposit = build_deposit_for_index(spec, state, new_index, signed=False)
+    pre_validator_count = len(state.validators)
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert len(state.validators) == pre_validator_count
+    assert int(state.eth1_deposit_index) == int(state.eth1_data.deposit_count)
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_top_up_effective_balance_stays_capped(spec, state):
+    from ..testlib.deposits import build_deposit_for_index
+
+    # top-up onto an at-cap validator: balance grows, effective balance
+    # cannot move inside process_deposit (it only updates at epoch
+    # processing, and is capped at MAX_EFFECTIVE_BALANCE there too)
+    assert state.validators[0].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+    deposit = build_deposit_for_index(
+        spec, state, 0, amount=spec.EFFECTIVE_BALANCE_INCREMENT)
+    pre_balance = int(state.balances[0])
+    yield from _run_op(spec, state, "deposit", deposit)
+    assert int(state.balances[0]) == pre_balance + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    assert state.validators[0].effective_balance == spec.MAX_EFFECTIVE_BALANCE
+
+
 # --- voluntary exit churn ----------------------------------------------------
 
 

@@ -19,6 +19,26 @@ GWEI = 10**9
 ETHER = 10**18
 
 
+class DepositRevert(AssertionError):
+    """A require() failure, carrying the contract's exact revert reason.
+
+    Subclasses AssertionError so callers treating the twin's checks as
+    assertions keep working, but raises even under `python -O` (a bare
+    `assert` would vanish) and lets the differential suite
+    (evm/differential.py) compare reasons string-for-string with the
+    Error(string) payload the EVM bytecode reverts with.
+    """
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _require(condition: bool, reason: str) -> None:
+    if not condition:
+        raise DepositRevert(reason)
+
+
 def sha256(b: bytes) -> bytes:
     return _sha256(b).digest()
 
@@ -54,14 +74,18 @@ class DepositContractTwin:
 
     def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
                 signature: bytes, deposit_data_root: bytes, msg_value: int) -> None:
-        assert len(pubkey) == 48, "invalid pubkey length"
-        assert len(withdrawal_credentials) == 32, "invalid withdrawal_credentials length"
-        assert len(signature) == 96, "invalid signature length"
+        # reasons are byte-identical to the .sol require() strings so the
+        # twin<->EVM differential suite can assert revert-for-revert equality
+        _require(len(pubkey) == 48, "DepositContract: invalid pubkey length")
+        _require(len(withdrawal_credentials) == 32,
+                 "DepositContract: invalid withdrawal_credentials length")
+        _require(len(signature) == 96, "DepositContract: invalid signature length")
 
-        assert msg_value >= 1 * ETHER, "deposit value too low"
-        assert msg_value % GWEI == 0, "deposit value not multiple of gwei"
+        _require(msg_value >= 1 * ETHER, "DepositContract: deposit value too low")
+        _require(msg_value % GWEI == 0,
+                 "DepositContract: deposit value not multiple of gwei")
         deposit_amount = msg_value // GWEI
-        assert deposit_amount <= 2**64 - 1, "deposit value too high"
+        _require(deposit_amount <= 2**64 - 1, "DepositContract: deposit value too high")
 
         # (the .sol emits the event here; Python has no revert, so the emit
         # moves after the asserts to preserve the EVM's rollback atomicity)
@@ -73,11 +97,12 @@ class DepositContractTwin:
             sha256(pubkey_root + withdrawal_credentials)
             + sha256(to_little_endian_64(deposit_amount) + b"\x00" * 24 + signature_root)
         )
-        assert node == deposit_data_root, (
-            "reconstructed DepositData does not match supplied deposit_data_root"
-        )
+        _require(node == deposit_data_root,
+                 "DepositContract: reconstructed DepositData does not match "
+                 "supplied deposit_data_root")
 
-        assert self.deposit_count < MAX_DEPOSIT_COUNT, "merkle tree full"
+        _require(self.deposit_count < MAX_DEPOSIT_COUNT,
+                 "DepositContract: merkle tree full")
         self.events.append({
             "pubkey": pubkey,
             "withdrawal_credentials": withdrawal_credentials,

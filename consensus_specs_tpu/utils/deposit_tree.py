@@ -24,6 +24,13 @@ from .hash import hash_eth2 as sha256
 DEPOSIT_CONTRACT_TREE_DEPTH = 32
 
 
+class TreeFullError(AssertionError):
+    """Insert past 2**depth - 1 leaves (the contract's "merkle tree full"
+    revert — one slot stays free so the count mix-in can never collide with
+    a full bottom layer).  Subclasses AssertionError for existing callers,
+    but survives `python -O`."""
+
+
 def _zero_hashes(depth: int = DEPOSIT_CONTRACT_TREE_DEPTH) -> list[bytes]:
     zh = [b"\x00" * 32]
     for _ in range(depth - 1):
@@ -35,10 +42,17 @@ ZERO_HASHES = _zero_hashes()
 
 
 class DepositTree:
-    """Incremental depth-32 Merkle accumulator with count mix-in."""
+    """Incremental depth-32 Merkle accumulator with count mix-in.
 
-    def __init__(self) -> None:
-        self.branch: list[bytes] = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+    `depth` parameterizes the accumulator so the tree-full boundary (2**32-1
+    inserts on the real contract — unreachable in a test) can be exercised at
+    a small depth; production callers never pass it.
+    """
+
+    def __init__(self, depth: int = DEPOSIT_CONTRACT_TREE_DEPTH) -> None:
+        assert 1 <= depth <= DEPOSIT_CONTRACT_TREE_DEPTH
+        self.depth = depth
+        self.branch: list[bytes] = [b"\x00" * 32] * depth
         self.leaves: list[bytes] = []  # retained for proof tooling
 
     @property
@@ -48,11 +62,15 @@ class DepositTree:
     def push(self, leaf: bytes) -> None:
         """Insert hash_tree_root(DepositData); one branch node changes."""
         assert len(leaf) == 32
-        assert self.deposit_count < 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1, "tree full"
+        if self.deposit_count >= 2**self.depth - 1:
+            # the contract's `require(deposit_count < MAX_DEPOSIT_COUNT,
+            # "DepositContract: merkle tree full")` — same boundary, and a
+            # real exception so host tooling cannot overfill under -O
+            raise TreeFullError("merkle tree full")
         self.leaves.append(leaf)
         size = self.deposit_count
         node = leaf
-        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+        for h in range(self.depth):
             if size & 1:
                 self.branch[h] = node
                 return
@@ -64,7 +82,7 @@ class DepositTree:
         """`get_deposit_root()`: branch fold + little-endian count mix-in."""
         node = b"\x00" * 32
         size = self.deposit_count
-        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+        for h in range(self.depth):
             if size & 1:
                 node = sha256(self.branch[h] + node)
             else:
@@ -73,15 +91,16 @@ class DepositTree:
         return sha256(node + self.deposit_count.to_bytes(8, "little") + b"\x00" * 24)
 
     def proof(self, index: int) -> list[bytes]:
-        """33-element branch for leaf `index` against the CURRENT root:
-        32 sibling hashes plus the count mix-in node, the exact shape
-        `process_deposit` verifies at depth DEPOSIT_CONTRACT_TREE_DEPTH + 1."""
+        """(depth+1)-element branch for leaf `index` against the CURRENT
+        root: depth sibling hashes plus the count mix-in node — at the
+        default depth, the exact 33-node shape `process_deposit` verifies at
+        DEPOSIT_CONTRACT_TREE_DEPTH + 1."""
         assert 0 <= index < self.deposit_count
         # level 0 = padded leaves; level h nodes pair into level h+1
         level = list(self.leaves)
         proof: list[bytes] = []
         idx = index
-        for h in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+        for h in range(self.depth):
             sibling = idx ^ 1
             proof.append(level[sibling] if sibling < len(level) else ZERO_HASHES[h])
             nxt = []

@@ -46,6 +46,10 @@ def pytest_configure(config):
         "markers",
         "slow: multi-minute compile-bound crypto tests; default `make test` "
         "lane skips them, `make citest`/`testall` runs everything")
+    config.addinivalue_line(
+        "markers",
+        "evm: deposit-contract EVM harness / twin differential conformance "
+        "tests (pure Python, no accelerator)")
 
     preset = config.getoption("--preset")
     if preset:

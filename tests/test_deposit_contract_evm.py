@@ -1,0 +1,215 @@
+"""Twin <-> EVM differential conformance for the deposit contract.
+
+Executes the checked-in solidity_deposit_contract/deposit_contract.json
+bytecode opcode-by-opcode under consensus_specs_tpu/evm/ and holds it to
+the Python twin (utils/deposit_contract_twin.py) transaction-for-
+transaction: deposit root, deposit count, DepositEvent payloads, and
+revert-for-revert agreement including the exact Error(string) reason.
+The headline test is a >=1,000-transaction randomized run mixing valid
+and adversarial deposits with zero tolerated divergences.
+"""
+from hashlib import sha256
+
+import pytest
+
+from consensus_specs_tpu.evm.build import render_artifact
+from consensus_specs_tpu.evm.contract import ContractHarness
+from consensus_specs_tpu.evm.deposit_contract_asm import (
+    ALL_REVERT_REASONS,
+    SLOT_COUNT,
+    build_artifact,
+)
+from consensus_specs_tpu.evm.differential import (
+    ARTIFACT_PATH,
+    DifferentialRunner,
+    deposit_data_root,
+    run_differential,
+)
+from consensus_specs_tpu.utils.deposit_contract_twin import (
+    DepositContractTwin,
+    DepositRevert,
+    GWEI,
+    MAX_DEPOSIT_COUNT,
+)
+
+pytestmark = pytest.mark.evm
+
+
+# -- artifact integrity ------------------------------------------------------
+
+def test_checked_in_artifact_is_fresh():
+    """The committed JSON must be byte-identical to what the assembler
+    emits today — the artifact is a conformance anchor, not a cache."""
+    assert ARTIFACT_PATH.exists(), "run `make deposit_contract_json`"
+    assert ARTIFACT_PATH.read_text() == render_artifact()
+
+
+def test_artifact_build_deterministic():
+    a, b = build_artifact(), build_artifact()
+    assert a == b
+    assert a["bytecode"] == b["bytecode"]
+
+
+def test_constructor_initializes_zero_hash_ladder():
+    h = ContractHarness.from_artifact(build_artifact())
+    h.deploy()
+    twin = DepositContractTwin()
+    # slots 33..64 carry zero_hashes[0..31]; slot 33 (zero_hashes[0]) is 0
+    for i in range(32):
+        expected = int.from_bytes(twin.zero_hashes[i], "big")
+        assert h.storage.get(33 + i, 0) == expected, f"zero_hashes[{i}]"
+    assert h.storage.get(SLOT_COUNT, 0) == 0
+
+
+# -- fixture -----------------------------------------------------------------
+
+@pytest.fixture()
+def pair():
+    h = ContractHarness.from_artifact(
+        ARTIFACT_PATH if ARTIFACT_PATH.exists() else build_artifact())
+    h.deploy()
+    return h, DepositContractTwin()
+
+
+def _valid_args(i: int, amount_gwei: int = 32 * 10**9):
+    pk = sha256(b"pk%d" % i).digest() + sha256(b"pk2%d" % i).digest()[:16]
+    wc = sha256(b"wc%d" % i).digest()
+    sig = (sha256(b"s1%d" % i).digest() + sha256(b"s2%d" % i).digest()
+           + sha256(b"s3%d" % i).digest())
+    return pk, wc, sig, deposit_data_root(pk, wc, sig, amount_gwei)
+
+
+# -- static conformance ------------------------------------------------------
+
+def test_empty_root_matches_canonical(pair):
+    h, twin = pair
+    res = h.call("get_deposit_root")
+    assert res.success
+    assert bytes(res.returned[0]) == twin.get_deposit_root()
+    assert bytes(res.returned[0]).hex() == (
+        "d70a234731285c6804c2a4f56711ddb8c82c99740f207854891028af34e27e5e")
+
+
+def test_deposit_event_matches_twin(pair):
+    h, twin = pair
+    pk, wc, sig, root = _valid_args(0)
+    res = h.call("deposit", [pk, wc, sig, root], value=32 * 10**18)
+    assert res.success, (res.error, res.revert_reason)
+    twin.deposit(pk, wc, sig, root, msg_value=32 * 10**18)
+    [ev] = res.events
+    assert ev.name == "DepositEvent"
+    te = twin.events[-1]
+    assert ev.args == [te["pubkey"], te["withdrawal_credentials"],
+                       te["amount"], te["signature"], te["index"]]
+    assert ev.args[2] == (32 * 10**9).to_bytes(8, "little")
+    assert ev.args[4] == (0).to_bytes(8, "little")
+
+
+def test_supports_interface(pair):
+    h, _ = pair
+    assert h.call("supportsInterface", [bytes.fromhex("01ffc9a7")]).returned == [True]
+    assert h.call("supportsInterface", [bytes.fromhex("85640907")]).returned == [True]
+    assert h.call("supportsInterface", [bytes.fromhex("ffffffff")]).returned == [False]
+
+
+REVERT_CASES = [
+    # (mutate(pk, wc, sig, root, value) -> args, expected reason suffix)
+    (lambda pk, wc, sig, root, v: ((pk[:-1], wc, sig, root), v),
+     "invalid pubkey length"),
+    (lambda pk, wc, sig, root, v: ((pk, wc + b"\x00", sig, root), v),
+     "invalid withdrawal_credentials length"),
+    (lambda pk, wc, sig, root, v: ((pk, wc, sig[:-1], root), v),
+     "invalid signature length"),
+    (lambda pk, wc, sig, root, v: ((pk, wc, sig, root), 10**18 - 1),
+     "deposit value too low"),
+    (lambda pk, wc, sig, root, v: ((pk, wc, sig, root), v + 1),
+     "deposit value not multiple of gwei"),
+    (lambda pk, wc, sig, root, v: ((pk, wc, sig, root), (2**64) * GWEI),
+     "deposit value too high"),
+    (lambda pk, wc, sig, root, v: ((pk, wc, sig, bytes(32)), v),
+     "does not match supplied deposit_data_root"),
+]
+
+
+@pytest.mark.parametrize("mutate,suffix", REVERT_CASES,
+                         ids=[s for _, s in REVERT_CASES])
+def test_revert_reason_parity(pair, mutate, suffix):
+    h, twin = pair
+    pk, wc, sig, root = _valid_args(1)
+    (args, value) = mutate(pk, wc, sig, root, 32 * 10**18)
+    res = h.call("deposit", list(args), value=value)
+    assert not res.success and res.error is None
+    assert suffix in res.revert_reason
+    with pytest.raises(DepositRevert) as exc:
+        twin.deposit(*args, msg_value=value)
+    assert res.revert_reason == exc.value.reason
+    # rollback: state unchanged on both sides
+    assert h.storage.get(SLOT_COUNT, 0) == 0 and twin.deposit_count == 0
+    assert bytes(h.call("get_deposit_root").returned[0]) == twin.get_deposit_root()
+
+
+def test_all_revert_reasons_reachable():
+    """Every Error(string) embedded in the bytecode is exercised by the
+    parity table above plus the tree-full boundary test."""
+    covered = {s for _, s in REVERT_CASES} | {"merkle tree full"}
+    for reason in ALL_REVERT_REASONS:
+        assert any(c in reason for c in covered), reason
+
+
+def test_tree_full_boundary(pair):
+    h, twin = pair
+    h.storage[SLOT_COUNT] = MAX_DEPOSIT_COUNT - 1
+    twin.deposit_count = MAX_DEPOSIT_COUNT - 1
+    pk, wc, sig, root = _valid_args(2)
+    # last free slot accepts
+    res = h.call("deposit", [pk, wc, sig, root], value=32 * 10**18)
+    twin.deposit(pk, wc, sig, root, msg_value=32 * 10**18)
+    assert res.success
+    assert res.events[0].args[4] == (MAX_DEPOSIT_COUNT - 1).to_bytes(8, "little")
+    assert h.storage[SLOT_COUNT] == MAX_DEPOSIT_COUNT == twin.deposit_count
+    assert bytes(h.call("get_deposit_root").returned[0]) == twin.get_deposit_root()
+    # one past capacity reverts identically
+    pk, wc, sig, root = _valid_args(3)
+    res = h.call("deposit", [pk, wc, sig, root], value=32 * 10**18)
+    assert not res.success
+    assert res.revert_reason == "DepositContract: merkle tree full"
+    with pytest.raises(DepositRevert, match="merkle tree full"):
+        twin.deposit(pk, wc, sig, root, msg_value=32 * 10**18)
+    assert h.storage[SLOT_COUNT] == MAX_DEPOSIT_COUNT == twin.deposit_count
+
+
+def test_sequence_of_valid_deposits_matches_twin(pair):
+    h, twin = pair
+    amounts = [1 * 10**9, 32 * 10**9, 2**64 - 1, 10**10 + 5, 999 * 10**9]
+    for i, amount in enumerate(amounts):
+        pk, wc, sig, root = _valid_args(100 + i, amount)
+        res = h.call("deposit", [pk, wc, sig, root], value=amount * GWEI)
+        assert res.success, (i, res.error, res.revert_reason)
+        twin.deposit(pk, wc, sig, root, msg_value=amount * GWEI)
+        assert bytes(h.call("get_deposit_root").returned[0]) == twin.get_deposit_root()
+        assert bytes(h.call("get_deposit_count").returned[0]) == twin.get_deposit_count()
+
+
+# -- the headline randomized differential run --------------------------------
+
+def test_randomized_differential_1000_tx():
+    """>=1,000 transactions (valid + adversarial) through both the EVM
+    bytecode and the Python twin; zero divergences tolerated."""
+    report = run_differential(n=1000, seed=0xD3705)
+    assert report.transactions >= 1000
+    # every scenario class must actually have been drawn
+    assert set(report.scenario_counts) == {
+        "valid", "wrong_root", "bad_pubkey_len", "bad_wc_len", "bad_sig_len",
+        "value_too_low", "value_not_gwei", "value_too_high", "tree_full",
+        "garbage_calldata"}
+    assert report.reverts > 100  # adversarial mix really fired
+    assert report.ok, "\n".join(
+        f"tx {d.tx} [{d.scenario}] {d.kind}: {d.detail}"
+        for d in report.divergences[:20])
+
+
+def test_differential_seeds_are_independent():
+    r1 = DifferentialRunner(seed=1).run(60)
+    r2 = DifferentialRunner(seed=2).run(60)
+    assert r1.ok and r2.ok
+    assert r1.scenario_counts != r2.scenario_counts or r1.reverts != r2.reverts

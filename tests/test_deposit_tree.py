@@ -89,3 +89,56 @@ def test_proof_against_spec_process_deposit():
             index=i,
             root=spec.Bytes32(root),
         )
+
+
+def test_tree_full_boundary_small_depth():
+    """Capacity is 2**depth - 1 (one slot reserved so the count mix-in can
+    never collide with a full bottom layer); the overfull insert raises the
+    contract's "merkle tree full" — exercised at depth 3 because 2**32 - 1
+    real inserts is not a test."""
+    from consensus_specs_tpu.utils.deposit_tree import TreeFullError
+
+    t = DepositTree(depth=3)
+    for i in range(7):  # 2**3 - 1 leaves fit
+        t.push(leaf(i))
+    assert t.deposit_count == 7
+    root_before = t.root()
+    with pytest.raises(TreeFullError, match="merkle tree full"):
+        t.push(leaf(7))
+    # failed insert left the accumulator untouched
+    assert t.deposit_count == 7
+    assert t.root() == root_before
+    # TreeFullError is still an AssertionError for legacy except clauses
+    assert issubclass(TreeFullError, AssertionError)
+
+
+def test_small_depth_proofs_stay_valid():
+    t = DepositTree(depth=4)
+    for i in range(15):
+        t.push(leaf(i))
+    root = t.root()
+    for i in (0, 7, 14):
+        proof = t.proof(i)
+        assert len(proof) == 4 + 1
+        assert is_valid_deposit_proof(leaf(i), proof, i, root)
+
+
+def test_twin_matches_tree_full_reason():
+    """The Python twin's capacity revert carries the same reason string, so
+    the EVM differential layer can compare all three word-for-word."""
+    from consensus_specs_tpu.utils.deposit_contract_twin import (
+        DepositContractTwin,
+        DepositRevert,
+        MAX_DEPOSIT_COUNT,
+    )
+
+    from consensus_specs_tpu.evm.differential import deposit_data_root
+
+    twin = DepositContractTwin()
+    twin.deposit_count = MAX_DEPOSIT_COUNT
+    pk, wc, sig = b"\x11" * 48, b"\x22" * 32, b"\x33" * 96
+    # root must be CORRECT: the contract checks it before capacity
+    root = deposit_data_root(pk, wc, sig, 32 * 10**9)
+    with pytest.raises(DepositRevert, match="merkle tree full") as exc:
+        twin.deposit(pk, wc, sig, root, msg_value=32 * 10**18)
+    assert exc.value.reason == "DepositContract: merkle tree full"

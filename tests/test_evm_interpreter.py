@@ -1,0 +1,273 @@
+"""Unit tests for the minimal EVM harness (consensus_specs_tpu/evm/):
+keccak-256 vectors, assembler round-trips, interpreter opcode semantics,
+ABI encode/decode, and revert-reason decoding."""
+import pytest
+
+from consensus_specs_tpu.evm.abi import (
+    ABIError,
+    decode_abi,
+    decode_revert_reason,
+    encode_abi,
+    encode_call,
+    event_topic,
+    function_selector,
+)
+from consensus_specs_tpu.evm.asm import Asm, AsmError
+from consensus_specs_tpu.evm.interpreter import Code, EVM
+from consensus_specs_tpu.evm.keccak import keccak256
+from consensus_specs_tpu.evm.opcodes import BY_NAME, BY_VALUE
+
+
+# -- keccak-256 --------------------------------------------------------------
+
+KECCAK_VECTORS = [
+    # Ethereum keccak-256 (0x01 padding), NOT NIST SHA3-256 (0x06 padding)
+    (b"", "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"),
+    (b"abc", "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"),
+    (b"deposit(bytes,bytes,bytes,bytes32)",
+     "228951186529ab0efc339ef5c94ccc3410bec3d3dbe1d4b869a6c6a2ba1de999"),
+    (b"get_deposit_root()",
+     "c5f2892f793909d60442da8894c2b8a8a4f96e729be0468feee3d23beba3c819"),
+    (b"get_deposit_count()",
+     "621fd130644659204038b345ef11da476ec8be3c04f005f988e95d80b3750dd3"),
+    (b"supportsInterface(bytes4)",
+     "01ffc9a7a5cef8baa21ed3c5c0d7e23accb804b619e9333b597f47a0d84076e2"),
+    # one-past-rate block boundary (137 bytes forces a second permutation)
+    (b"\xaa" * 137,
+     "0f018f4a7d578f411e6f2a380295e8abff3ba307c4a497253af577d0fb3d7592"),
+]
+
+
+@pytest.mark.parametrize("data,digest", KECCAK_VECTORS,
+                         ids=[f"len{len(d)}" for d, _ in KECCAK_VECTORS])
+def test_keccak256_vectors(data, digest):
+    assert keccak256(data).hex() == digest
+
+
+def test_keccak256_incremental_lengths():
+    # every padding branch around the 136-byte rate
+    for n in (0, 1, 55, 56, 135, 136, 137, 271, 272, 273):
+        out = keccak256(b"\x5c" * n)
+        assert len(out) == 32
+        # self-consistency: same input, same output
+        assert out == keccak256(b"\x5c" * n)
+
+
+# -- opcode table ------------------------------------------------------------
+
+def test_opcode_table_bijective():
+    assert len(BY_NAME) == len(BY_VALUE)
+    for name, info in BY_NAME.items():
+        assert BY_VALUE[info.value] is info
+        assert info.name == name
+
+
+# -- assembler ---------------------------------------------------------------
+
+def test_asm_push_width_minimal():
+    code = Asm().push(0).push(0xFF).push(0x100).assemble()
+    assert code == bytes([0x60, 0x00, 0x60, 0xFF, 0x61, 0x01, 0x00])
+
+
+def test_asm_label_jump_roundtrip():
+    a = Asm()
+    a.push_label("end").op("JUMP")
+    a.op("INVALID")
+    a.label("end")
+    a.push(7).push(0).op("MSTORE").push(32).push(0).op("RETURN")
+    result = EVM(Code(a.assemble())).execute()
+    assert result.success
+    assert int.from_bytes(result.output, "big") == 7
+
+
+def test_asm_unknown_label():
+    a = Asm()
+    a.push_label("nowhere")
+    with pytest.raises(AsmError):
+        a.assemble()
+
+
+# -- interpreter semantics ---------------------------------------------------
+
+def run_ops(build, calldata=b"", value=0, storage=None):
+    a = Asm()
+    build(a)
+    return EVM(Code(a.assemble()), storage=storage).execute(calldata, value)
+
+
+def ret_top(a):
+    """Store stack top at mem[0] and return the 32-byte word."""
+    a.push(0).op("MSTORE").push(32).push(0).op("RETURN")
+
+
+@pytest.mark.parametrize("op,a_val,b_val,expect", [
+    ("ADD", 3, 4, 7),
+    ("ADD", 2**256 - 1, 2, 1),                 # wraps mod 2**256
+    ("SUB", 10, 3, 7),                          # first pop is minuend
+    ("SUB", 3, 10, 2**256 - 7),
+    ("MUL", 2**128, 2**128, 0),
+    ("DIV", 7, 2, 3),
+    ("DIV", 7, 0, 0),                           # EVM: div by zero is zero
+    ("MOD", 7, 3, 1),
+    ("MOD", 7, 0, 0),
+    ("LT", 3, 4, 1),
+    ("LT", 4, 3, 0),
+    ("GT", 4, 3, 1),
+    ("EQ", 5, 5, 1),
+    ("AND", 0b1100, 0b1010, 0b1000),
+    ("OR", 0b1100, 0b1010, 0b1110),
+    ("XOR", 0b1100, 0b1010, 0b0110),
+    ("SHL", 4, 1, 16),                          # first pop is shift amount
+    ("SHR", 4, 32, 2),
+    ("SHR", 300, 2**255, 0),                    # oversized shift drains
+])
+def test_binary_ops(op, a_val, b_val, expect):
+    # push b first so a is on top (a is the FIRST pop = mu_s[0])
+    res = run_ops(lambda asm: (asm.push(b_val), asm.push(a_val), asm.op(op),
+                               ret_top(asm)))
+    assert res.success, res.error
+    assert int.from_bytes(res.output, "big") == expect
+
+
+def test_iszero_not():
+    res = run_ops(lambda a: (a.push(0), a.op("ISZERO"), ret_top(a)))
+    assert int.from_bytes(res.output, "big") == 1
+    res = run_ops(lambda a: (a.push(0), a.op("NOT"), ret_top(a)))
+    assert int.from_bytes(res.output, "big") == 2**256 - 1
+
+
+def test_memory_mstore8_msize():
+    def build(a):
+        a.push(0xAB).push(5).op("MSTORE8")   # one byte at offset 5
+        a.op("MSIZE")                         # memory expanded to 32
+        ret_top(a)
+    res = run_ops(build)
+    assert int.from_bytes(res.output, "big") == 32
+
+
+def test_calldata_ops():
+    def build(a):
+        a.op("CALLDATASIZE")
+        a.push(2).op("CALLDATALOAD")  # word at offset 2, zero-padded tail
+        a.op("ADD")
+        ret_top(a)
+    res = run_ops(build, calldata=b"\x00\x00\xff" + b"\x00" * 31)
+    # CALLDATASIZE=34; CALLDATALOAD(2) = 0xff000...0 as full word
+    assert int.from_bytes(res.output, "big") == 34 + (0xFF << 248)
+
+
+def test_storage_persistence_and_delete():
+    storage = {}
+    res = run_ops(lambda a: (a.push(42), a.push(9), a.op("SSTORE"), a.op("STOP")),
+                  storage=storage)
+    assert res.success and storage == {9: 42}
+    run_ops(lambda a: (a.push(0), a.push(9), a.op("SSTORE"), a.op("STOP")),
+            storage=storage)
+    assert storage == {}  # zero-writes delete the key
+
+
+def test_revert_and_error_string():
+    # REVERT with an Error(string) payload built via the ABI helper
+    payload = bytes.fromhex("08c379a0") + encode_abi(["string"], ["nope"])
+    a = Asm()
+    for i, byte in enumerate(payload):
+        a.push(byte).push(i).op("MSTORE8")
+    a.push(len(payload)).push(0).op("REVERT")
+    res = EVM(Code(a.assemble())).execute()
+    assert not res.success and res.reverted
+    assert decode_revert_reason(res.output) == "nope"
+
+
+def test_stack_underflow_is_exceptional():
+    res = run_ops(lambda a: a.op("ADD"))
+    assert not res.success and not res.reverted
+    assert "underflow" in res.error
+
+
+def test_bad_jump_is_exceptional():
+    res = run_ops(lambda a: (a.push(3), a.op("JUMP"), a.op("STOP")))
+    assert not res.success and "jump destination" in res.error
+
+
+def test_invalid_opcode_is_exceptional():
+    res = EVM(Code(b"\xfe")).execute()
+    assert not res.success and not res.reverted
+
+
+def test_step_limit():
+    # infinite loop: JUMPDEST; PUSH 0; JUMP
+    code = Code(bytes([0x5B, 0x60, 0x00, 0x56]))
+    res = EVM(code, step_limit=1000).execute()
+    assert not res.success and "step budget" in res.error
+
+
+def test_sha256_precompile_staticcall():
+    from hashlib import sha256
+    def build(a):
+        a.push(0xAB).push(31).op("MSTORE8")  # mem[31] = 0xAB
+        # STATICCALL(gas, 0x02, in=0, insize=32, out=0x20, outsize=32)
+        a.push(32).push(0x20).push(32).push(0).push(2).op("GAS").op("STATICCALL")
+        a.op("POP")
+        a.push(32).push(0x20).op("RETURN")
+    res = run_ops(build)
+    assert res.success
+    assert res.output == sha256(b"\x00" * 31 + b"\xab").digest()
+
+
+def test_log_capture():
+    def build(a):
+        a.push(0xDEAD).push(0).op("MSTORE")
+        a.push(0x1234).push(32).push(0).op("LOG1")
+        a.op("STOP")
+    res = run_ops(build)
+    assert res.success and len(res.logs) == 1
+    assert res.logs[0].topics == [0x1234]
+    assert int.from_bytes(res.logs[0].data, "big") == 0xDEAD
+
+
+# -- ABI ---------------------------------------------------------------------
+
+def test_selector_and_topic():
+    assert function_selector("deposit(bytes,bytes,bytes,bytes32)").hex() == "22895118"
+    assert function_selector("get_deposit_root()").hex() == "c5f2892f"
+    assert function_selector("get_deposit_count()").hex() == "621fd130"
+    assert function_selector("supportsInterface(bytes4)").hex() == "01ffc9a7"
+    assert event_topic("DepositEvent(bytes,bytes,bytes,bytes,bytes)").hex() == (
+        "649bbc62d0e31342afea4e5cd82d4049e7e1ee912fc0889aa790803be39038c5")
+
+
+def test_abi_roundtrip_dynamic_bytes():
+    types = ["bytes", "bytes", "bytes", "bytes32"]
+    values = [b"\x01" * 48, b"\x02" * 32, b"\x03" * 96, b"\x04" * 32]
+    blob = encode_abi(types, values)
+    assert decode_abi(types, blob) == values
+    # head is 4 words; dynamic tails are length-prefixed and 32-padded
+    assert len(blob) == 32 * 4 + (32 + 64) + (32 + 32) + (32 + 96)
+
+
+def test_abi_roundtrip_uints():
+    types = ["uint256", "uint64", "bool", "bytes4"]
+    values = [2**255 + 1, 2**64 - 1, True, b"\x85\x64\x09\x07"]
+    assert decode_abi(types, encode_abi(types, values)) == values
+
+
+def test_encode_call_prefixes_selector():
+    blob = encode_call("supportsInterface(bytes4)", [b"\x01\xff\xc9\xa7"])
+    assert blob[:4].hex() == "01ffc9a7" and len(blob) == 4 + 32
+
+
+def test_decode_abi_bounds_checked():
+    blob = encode_abi(["bytes"], [b"\xaa" * 40])
+    with pytest.raises(ABIError):
+        decode_abi(["bytes"], blob[:96])  # tail shorter than its length word
+    with pytest.raises(ABIError):
+        decode_abi(["uint256", "uint256"], b"\x00" * 32)  # truncated head
+
+
+def test_decode_revert_reason_shapes():
+    assert decode_revert_reason(b"") is None
+    assert decode_revert_reason(b"\x00" * 3) is None
+    err = bytes.fromhex("08c379a0") + encode_abi(["string"], ["boom"])
+    assert decode_revert_reason(err) == "boom"
+    panic = bytes.fromhex("4e487b71") + encode_abi(["uint256"], [0x11])
+    assert decode_revert_reason(panic) == "Panic(0x11)"

@@ -1,0 +1,53 @@
+"""Deterministic spec-artifact emission (`make pyspec ARTIFACTS=1`).
+
+The flattened per-(fork x preset) sources must be byte-stable: two
+consecutive renders are identical, the emitted file round-trips through
+disk unchanged, and the content carries the resolved constants/config the
+in-memory build_spec links against."""
+import py_compile
+
+import pytest
+
+from consensus_specs_tpu.compiler.spec_compiler import (
+    emit_spec_artifact,
+    render_spec_source,
+)
+
+pytestmark = pytest.mark.evm  # rides the host-only (no accelerator) lane
+
+
+def test_render_is_deterministic():
+    for fork, preset in [("phase0", "minimal"), ("altair", "mainnet")]:
+        assert render_spec_source(fork, preset) == render_spec_source(fork, preset)
+
+
+def test_emit_round_trips_byte_identical(tmp_path):
+    path = emit_spec_artifact("phase0", "minimal", out_dir=tmp_path)
+    assert path.name == "phase0_minimal.py"
+    first = path.read_bytes()
+    assert emit_spec_artifact("phase0", "minimal", out_dir=tmp_path) == path
+    assert path.read_bytes() == first
+    assert first == render_spec_source("phase0", "minimal").encode()
+
+
+def test_artifact_is_valid_python(tmp_path):
+    path = emit_spec_artifact("bellatrix", "mainnet", out_dir=tmp_path)
+    py_compile.compile(str(path), doraise=True)
+
+
+def test_artifact_carries_resolved_composition(tmp_path):
+    text = render_spec_source("altair", "minimal")
+    # preset-resolved constant (minimal overrides mainnet's 2**5)
+    assert "SYNC_COMMITTEE_SIZE = 32" in text
+    # overlay order: phase0 document sections precede altair's
+    assert text.index("phase0/beacon-chain.md") < text.index("altair/beacon-chain.md")
+    assert "fork = 'altair'" in text
+    assert "preset_name = 'minimal'" in text
+    # frozen config block present
+    assert "config = Config(**{" in text
+
+
+def test_artifact_has_no_timestamps(tmp_path):
+    import re
+    text = render_spec_source("phase0", "minimal")
+    assert not re.search(r"20\d\d-\d\d-\d\d [0-2]\d:", text)
