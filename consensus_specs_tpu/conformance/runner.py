@@ -369,9 +369,19 @@ def _replay_fork_choice(spec, case_dir, meta):
     store = spec.get_forkchoice_store(anchor_state, anchor_block)
     steps = _read_yaml(case_dir, "steps") or []
     # merge-transition scenarios install a synthetic PoW view (`pow_block`
-    # steps); the spec's get_pow_block serves from it for this case only
+    # steps); the spec's get_pow_block serves from it for this case only.
+    # The patch mutates the CACHED, SHARED spec module — safe only because
+    # replay is strictly serial (one case at a time, restored in the
+    # finally); a parallel/threaded runner would need per-case spec
+    # instances. A case that carries pow_block steps against a spec with no
+    # get_pow_block (pre-bellatrix) must fail loudly here: installing the
+    # table anyway would silently feed a dead lookup (ADVICE r5).
     pow_table: dict = {}
     prev_get_pow = getattr(spec, "get_pow_block", None)
+    if prev_get_pow is None and any("pow_block" in step for step in steps):
+        raise AssertionError(
+            "fork-choice case contains pow_block steps but the spec has no "
+            "get_pow_block — pow view would be installed into a dead table")
     if prev_get_pow is not None:
         spec.get_pow_block = lambda block_hash: pow_table.get(bytes(block_hash))
     try:

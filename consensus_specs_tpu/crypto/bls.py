@@ -139,9 +139,12 @@ class inline_verification:
 
 def _flush_deferred(queue):
     """queue: list of ("kind", args) tuples -> list[bool]."""
-    from . import bls_jax
-
     if _backend == "jax":
+        # Imported only on the jax path (ADVICE r5): a pure-Python-oracle
+        # process (no jax installed) must be able to defer, flush, and
+        # clear caches without this module ever being importable.
+        from . import bls_jax
+
         checks = []
         results = [None] * len(queue)
         for i, (kind, args) in enumerate(queue):
@@ -242,7 +245,10 @@ def clear_caches() -> None:
     The jax-backend caches are cleared only if `bls_jax` has already been
     imported — importing it here would drag in jax (and initialize a
     backend) from a pure-host code path that never used it, just to clear
-    caches that cannot have entries."""
+    caches that cannot have entries. Together with the deferred imports in
+    _flush_deferred/AggregatePKs this makes the whole py-backend surface
+    usable in a process where `bls_jax` cannot import at all (ADVICE r5;
+    covered by test_bls.py's poisoned-module subprocess test)."""
     import sys
 
     clear_sign_cache()
@@ -277,10 +283,11 @@ def AggregatePKs(pubkeys) -> bytes:
     contents change). Large aggregates route through the device G1
     reduction tree under the jax backend (512-member sync committees are
     one kernel launch instead of 511 host point-adds)."""
-    from . import bls_jax
+    if _backend == "jax":
+        from . import bls_jax  # jax path only; see _flush_deferred
 
-    if _backend == "jax" and len(pubkeys) >= bls_jax.DEVICE_AGGREGATE_MIN:
-        return bls_jax.aggregate_pubkeys_device(pubkeys)
+        if len(pubkeys) >= bls_jax.DEVICE_AGGREGATE_MIN:
+            return bls_jax.aggregate_pubkeys_device(pubkeys)
     return _py.AggregatePKs(pubkeys)
 
 

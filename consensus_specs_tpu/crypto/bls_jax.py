@@ -226,9 +226,81 @@ def _pack_pairing_args(p1s, q1s, p2s, q2s):
     return b, (qx, qy, px, py, q2x, q2y, p2x, p2y)
 
 
+# Observability for the most recent randomized flush (single-threaded bench
+# and test consumption only): which kernel path ran, the padded item/distinct
+# counts, and the Miller-loop bill it implies.
+LAST_FLUSH: dict = {}
+
+
+def _pack_grouped_args(p1s, q1s, q2s):
+    """Group checks by distinct q1 (the H(m) point) and pack the segmented
+    kernel's arguments: (b_n, b_d, (qx, qy, px, py, q2x, q2y), seg_ids).
+
+    q1 points come out of the hash_to_curve_g2 lru_cache, so equal messages
+    share one tuple — but grouping keys on the VALUE (nested int tuples,
+    hashable) so identity is an optimization, never a correctness input.
+
+    Padding: distinct count pads to a power of two (one jit cache entry per
+    (b_n, b_d) bucket pair, same stance as _bucket) and every pad group is
+    seeded with at least one pad item — an empty segment would sum to
+    infinity and fail the batch closed (see g1_segment_sum). Pad items are
+    identities by construction: e(G1, Q)·e(−G1, Q) == 1 for ANY G2 point Q,
+    so a pad item joining group g uses q1_g as its "signature". The item
+    bucket is therefore computed over n + pad_groups, which guarantees
+    pad_items >= pad_groups."""
+    from ..ops import bls12_jax as K
+
+    n = len(p1s)
+    gid: dict = {}
+    seg = []
+    reps = []
+    for q1 in q1s:
+        g = gid.get(q1)
+        if g is None:
+            g = gid[q1] = len(reps)
+            reps.append(q1)
+        seg.append(g)
+    d = len(reps)
+    b_d = 1
+    while b_d < d:
+        b_d *= 2
+    pad_groups = b_d - d
+    b_n = _bucket(n + pad_groups)
+
+    p1s = list(p1s)
+    q2s = list(q2s)
+    reps = reps + [_G2] * pad_groups
+    for j in range(b_n - n):
+        if j < pad_groups:
+            g = d + j  # seed each pad group with one valid member
+        else:
+            g = d if pad_groups else 0  # overflow riders join an existing group
+        p1s.append(_G1)
+        q2s.append(reps[g])  # sig := q1_g makes the pad check an identity
+        seg.append(g)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    enc = K.F.ints_to_mont_batch
+    px, py = enc([p[0] for p in p1s]), enc([p[1] for p in p1s])
+    qx = (enc([q[0][0] for q in reps]), enc([q[0][1] for q in reps]))
+    qy = (enc([q[1][0] for q in reps]), enc([q[1][1] for q in reps]))
+    q2x = (enc([s[0][0] for s in q2s]), enc([s[0][1] for s in q2s]))
+    q2y = (enc([s[1][0] for s in q2s]), enc([s[1][1] for s in q2s]))
+    seg_ids = jnp.asarray(np.array(seg, dtype=np.int32))
+    return b_n, b_d, (qx, qy, px, py, q2x, q2y), seg_ids
+
+
 def _device_check_all(p1s, q1s, p2s, q2s) -> bool:
     """Single-bool randomized batch check (pairing_check_rlc) with host-drawn
-    64-bit scalars; soundness error 2^-64 per flush."""
+    64-bit scalars; soundness error 2^-64 per flush.
+
+    When messages repeat across the batch (attestation workloads: every
+    committee of a slot signs the same root), the flush takes the segmented
+    kernel path — D+1 Miller loops for D distinct messages instead of
+    N+1. All-distinct batches keep the ungrouped kernel (the segment
+    reduce would be pure overhead at D == N)."""
     import jax
     import numpy as np
 
@@ -239,8 +311,19 @@ def _device_check_all(p1s, q1s, p2s, q2s) -> bool:
     # pins the invariant so a future check kind with a different base fails
     # loudly instead of silently verifying the wrong equation
     assert all(p2 is _NEG_G1 for p2 in p2s), "RLC fast path requires p2 == -G1"
-    b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
-    ok = K.pairing_check_rlc(*args, random_zbits(b), p2_is_neg_g1=True)
+    n = len(p1s)
+    if len(set(q1s)) < n:
+        b_n, b_d, args, seg_ids = _pack_grouped_args(p1s, q1s, q2s)
+        ok = K.pairing_check_rlc(*args, None, None, random_zbits(b_n),
+                                 p2_is_neg_g1=True, seg_ids=seg_ids)
+        LAST_FLUSH.clear()
+        LAST_FLUSH.update(path="rlc_grouped", items=b_n, distinct=b_d,
+                          miller_loops=b_d + 1)
+    else:
+        b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
+        ok = K.pairing_check_rlc(*args, random_zbits(b), p2_is_neg_g1=True)
+        LAST_FLUSH.clear()
+        LAST_FLUSH.update(path="rlc", items=b, distinct=b, miller_loops=b + 1)
     return bool(np.asarray(jax.device_get(ok)))
 
 
@@ -309,6 +392,29 @@ def bench_pairing_args(n: int, distinct: int = 8):
         dev(tile(enc([_NEG_G1[0]] * distinct))),
         dev(tile(enc([_NEG_G1[1]] * distinct))),
     )
+
+
+def bench_grouped_pairing_args(n: int, distinct: int = 8):
+    """Device-ready args for the SEGMENTED `pairing_check_rlc` fast path:
+    the same `n` valid triples `bench_pairing_args` tiles (identical sks
+    and messages), but packed through `_pack_grouped_args` — returns
+    ((qx, qy, px, py, q2x, q2y), seg_ids) so benches and tests compare the
+    grouped and ungrouped kernels on the SAME logical inputs."""
+    from .bls_sig import Sign
+
+    p1s, q1s, q2s = [], [], []
+    for i in range(n):
+        sk = 1000 + (i % distinct)
+        msg = b"bench message %d" % (i % distinct)
+        p1s.append(
+            oracle.pt_to_affine(
+                oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, sk)
+            )
+        )
+        q1s.append(hash_to_curve_g2(msg))
+        q2s.append(g2_from_bytes(bytes(Sign(sk, msg))))
+    _, _, args, seg_ids = _pack_grouped_args(p1s, q1s, q2s)
+    return args, seg_ids
 
 
 DEVICE_AGGREGATE_MIN = 32  # below this, host point-adds beat a kernel launch

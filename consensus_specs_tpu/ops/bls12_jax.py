@@ -1078,7 +1078,7 @@ def f12_prod_reduce(f):
 
 @partial(jax.jit, static_argnames=("p2_is_neg_g1",))
 def pairing_check_rlc(qx, qy, px, py, q2x, q2y, p2x, p2y, zbits,
-                      p2_is_neg_g1: bool = False):
+                      p2_is_neg_g1: bool = False, seg_ids=None):
     """Randomized batch verification with a SHARED final exponentiation:
 
         prod_i [ e(z_i·P1_i, Q1_i) · e(z_i·P2_i, Q2_i) ] == 1
@@ -1106,7 +1106,32 @@ def pairing_check_rlc(qx, qy, px, py, q2x, q2y, p2x, p2y, zbits,
     paid N+1 times instead of 2N (VERDICT r4 item 2). If Σ z_i·sig_i
     lands on the point at infinity the affine conversion degenerates and
     the check simply fails — unreachable for honest batches (probability
-    ~2^-64 over z), and an adversary gains nothing (failing closed)."""
+    ~2^-64 over z), and an adversary gains nothing (failing closed).
+
+    `seg_ids` (requires p2_is_neg_g1) applies the SAME bilinearity trick
+    to the first pairing set, grouped by distinct message: Q1 carries only
+    the D distinct H(m) points (leading dim D), `seg_ids` (N,) int32 maps
+    item i to its message group, and
+
+        prod_i e(z_i·pk_i, H(m_{g(i)})) = prod_g e(Σ_{i∈g} z_i·pk_i, H(m_g))
+
+    so the flush pays D+1 Miller loops instead of N+1 — for an epoch's
+    attestations every committee of a slot signs the same root, D ≪ N.
+    Soundness is unchanged: each item keeps its own independent z_i, so the
+    product is still prod_i [check_i]^{z_i} and the Schwartz-Zippel bound
+    stays 2^-64 per flush. The caller must give every segment in [0, D) at
+    least one member (an empty segment sums to infinity, degenerates the
+    affine conversion, and fails the batch closed — same stance as the G2
+    collapse note above)."""
+    if seg_ids is not None:
+        assert p2_is_neg_g1, "grouped RLC requires the collapsed -G1 sig side"
+        num_segments = qx[0].shape[0]
+        a1x, a1y = rlc_collapse_g1_by_message(px, py, zbits, seg_ids, num_segments)
+        m1 = miller_loop_batch(qx, qy, a1x, a1y)
+        aqx, aqy = rlc_collapse_g2(q2x, q2y, zbits)
+        ngx, ngy = _neg_g1_affine_mont()
+        m2 = miller_loop_batch(aqx, aqy, ngx, ngy)
+        return rlc_tail(m1, m2)
     a1x, a1y = rlc_randomize_g1(px, py, zbits)
     m1 = miller_loop_batch(qx, qy, a1x, a1y)
     if p2_is_neg_g1:
@@ -1142,6 +1167,53 @@ def rlc_collapse_g2(q2x, q2y, zbits):
     one2 = (one, jnp.zeros_like(one))
     zsig = g2_scalar_mul_batch((q2x, q2y, one2), zbits)
     return g2_jacobian_to_affine(g2_sum_reduce(zsig))
+
+
+def g1_segment_sum(pts, seg_ids, num_segments, first_segment=0):
+    """Segmented Jacobian G1 sum: out[d] = Σ_{i: seg_ids[i] == first_segment+d}.
+
+    `pts`: (N, limbs) coordinate arrays; `seg_ids`: (N,) int32;
+    `num_segments` static; `first_segment` may be traced (the mesh variant
+    passes axis_index·D_local so each device reduces only its segment
+    range). Non-members enter the tree reduce as the Jacobian zero (Z = 0),
+    which the complete g1_add absorbs — one masked (N, D) tree reduce, no
+    gather/scatter, shape-stable under jit. An empty segment returns
+    infinity; callers must not create one (the affine conversion downstream
+    degenerates and the batch check fails closed)."""
+    X, Y, Z = pts
+    n = X.shape[0]
+    segs = jnp.arange(num_segments, dtype=seg_ids.dtype) + first_segment
+    mask = seg_ids[:, None] == segs[None, :]  # (N, D)
+    shape = (n, num_segments) + X.shape[1:]
+    Xb = jnp.broadcast_to(X[:, None], shape)
+    Yb = jnp.broadcast_to(Y[:, None], shape)
+    Zb = jnp.where(mask[..., None], jnp.broadcast_to(Z[:, None], shape),
+                   jnp.zeros_like(Z[:, None]))
+    return g1_sum_reduce((Xb, Yb, Zb))
+
+
+def rlc_collapse_g1_by_message(px, py, zbits, seg_ids, num_segments,
+                               first_segment=0):
+    """Stage 1 (grouped): per-item [z_i]·pk_i via the 64-bit windowed G1
+    ladder, then a segmented sum per distinct message — (D,) affine points,
+    one Miller-loop operand per distinct H(m)."""
+    one = jnp.broadcast_to(jnp.asarray(F.ONE_MONT), px.shape).astype(px.dtype)
+    z1 = g1_scalar_mul_batch((px, py, one), zbits)
+    seg = g1_segment_sum(z1, seg_ids, num_segments, first_segment)
+    return _g1_jacobian_to_affine_batch(seg)
+
+
+def rlc_miller_loop_count(*millers) -> int:
+    """Miller-loop evaluations a set of stage outputs represents: the
+    leading batch dim of each Fp12 (1 when unbatched). Shape-only — works
+    on jax.eval_shape results, so the D+1 claim is assertable without
+    compiling; the grouped fast path costs exactly
+    rlc_miller_loop_count(m1, m2) == D + 1 loops."""
+    total = 0
+    for f in millers:
+        c = f[0][0]
+        total += int(c.shape[0]) if len(c.shape) > 1 else 1
+    return total
 
 
 def rlc_tail(m1, m2_single):

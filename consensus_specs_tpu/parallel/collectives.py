@@ -171,3 +171,88 @@ def pairing_check_rlc_mesh(mesh, qx, qy, px, py, q2x, q2y, p2x, p2y, zbits,
         for a in (qx, qy, px, py, q2x, q2y, p2x, p2y, zbits)
     )
     return _mesh_rlc_fn(mesh, p2_is_neg_g1)(*args)
+
+
+@lru_cache(maxsize=8)
+def _mesh_rlc_grouped_fn(mesh):
+    """Mesh-sharded SEGMENTED `pairing_check_rlc`: the distinct-message
+    collapse scaled across chips. Two axes ride the same mesh axis:
+
+    - ITEMS (N): each device runs the [z_i]·pk_i and [z_i]·sig_i 64-bit
+      ladders for its shard, then ONE all_gather moves the N randomized
+      Jacobian G1 points (~600 B/item) so every device can segment-sum any
+      group — membership is arbitrary, a group's items may live anywhere.
+    - GROUPS (D): the D distinct-message Miller loops partition across
+      devices; device k segment-sums and Miller-loops groups
+      [k·D/n_dev, (k+1)·D/n_dev) only. This is where the wall-clock lives
+      (the Fp12 squaring chain), so throughput scales with chip count.
+
+    The tail is one psum-style Fp12 PRODUCT collective (all_gather of
+    per-device Fp12 partials + replicated tree product — a group law, so
+    GSPMD's additive psum cannot express it, same stance as g1_mesh_sum),
+    the sig-side partial G2 points ride the gather round, and the single
+    final exponentiation runs replicated. Exact equality with the
+    single-device kernel: all reductions are modular group/field ops, so
+    association order cannot change the value."""
+    import jax.numpy as jnp
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=tuple([P(DATA_AXIS)] * 7) + (P(),),
+        out_specs=P(),
+    )
+    def grouped_shards(qx, qy, px, py, q2x, q2y, zbits, seg_ids):
+        d_local = qx[0].shape[0]  # D / n_devices distinct messages per device
+        base = jax.lax.axis_index(DATA_AXIS) * d_local
+        one = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), px.shape).astype(px.dtype)
+        z1_local = K.g1_scalar_mul_batch((px, py, one), zbits)
+        z1 = tuple(
+            jax.lax.all_gather(c, DATA_AXIS, axis=0, tiled=True) for c in z1_local)
+        segsum = K.g1_segment_sum(z1, seg_ids, d_local, first_segment=base)
+        a1x, a1y = K._g1_jacobian_to_affine_batch(segsum)
+        m1_local = K.miller_loop_batch(qx, qy, a1x, a1y)
+
+        # sig-side bilinearity collapse, sharded: local ladders + local sum,
+        # per-device partial G2 points gathered and folded replicated
+        oneq = jnp.broadcast_to(
+            jnp.asarray(K.F.ONE_MONT), q2x[0].shape).astype(q2x[0].dtype)
+        one2 = (oneq, jnp.zeros_like(oneq))
+        zsig = K.g2_scalar_mul_batch((q2x, q2y, one2), zbits)
+        local_pt = K.g2_sum_reduce(zsig)
+
+        def gather_f2(c):
+            return (
+                jax.lax.all_gather(c[0][None], DATA_AXIS, axis=0, tiled=True),
+                jax.lax.all_gather(c[1][None], DATA_AXIS, axis=0, tiled=True),
+            )
+
+        total_pt = K.g2_sum_reduce(tuple(gather_f2(c) for c in local_pt))
+        aqx, aqy = K.g2_jacobian_to_affine(total_pt)
+        ngx, ngy = K._neg_g1_affine_mont()
+        m2_single = K.miller_loop_batch(aqx, aqy, ngx, ngy)
+
+        local = K.f12_prod_reduce(m1_local)  # leading dim 1
+        gathered = jax.tree.map(
+            lambda c: jax.lax.all_gather(c, DATA_AXIS, axis=0, tiled=True), local)
+        return K.rlc_tail(gathered, m2_single)
+
+    return jax.jit(grouped_shards)
+
+
+def pairing_check_rlc_grouped_mesh(mesh, qx, qy, px, py, q2x, q2y, zbits,
+                                   seg_ids):
+    """Segmented randomized batch check sharded across `mesh`.
+
+    Same contract as the single-device grouped fast path
+    (`ops.bls12_jax.pairing_check_rlc(..., seg_ids=...)`): qx/qy carry the
+    D distinct H(m) points, seg_ids (N,) maps items to groups, every group
+    must be non-empty, and both N and D must divide by the mesh's device
+    count. seg_ids stays replicated (it is the only global index table);
+    item arrays shard on N, message arrays on D."""
+    split = NamedSharding(mesh, P(DATA_AXIS))
+    repl = NamedSharding(mesh, P())
+    args = tuple(
+        jax.device_put(a, split) for a in (qx, qy, px, py, q2x, q2y, zbits))
+    seg = jax.device_put(seg_ids, repl)
+    return _mesh_rlc_grouped_fn(mesh)(*args, seg)

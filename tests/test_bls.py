@@ -249,3 +249,48 @@ def test_expand_message_xmd_structure():
     assert len(a) == len(b) == 96
     assert a != b
     assert expand_message_xmd(b"msg", b"DST-A", 96) == a
+
+
+def test_py_backend_survives_unimportable_bls_jax():
+    """ADVICE r5: a pure-Python-oracle process (no jax importable) must be
+    able to Sign/Verify, defer+flush, AggregatePKs, and clear_caches without
+    the shim ever importing `bls_jax`. Run in a SUBPROCESS with the module
+    poisoned via a meta-path blocker — referenced by bls.clear_caches's
+    docstring as the coverage for its sys.modules.get guard."""
+    import subprocess
+    import sys
+
+    code = """
+import sys
+
+BLOCKED = "consensus_specs_tpu.crypto.bls_jax"
+
+
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == BLOCKED or name.split(".")[-1] == "jax" or name == "jax":
+            raise ImportError(f"poisoned for test: {name}")
+        return None
+
+
+sys.meta_path.insert(0, _Block())
+
+from consensus_specs_tpu.crypto import bls
+
+assert bls.backend() == "py"
+pk, msg = bls.SkToPk(7), b"no-jax process message"
+sig = bls.Sign(7, msg)
+assert bls.Verify(pk, msg, sig)
+assert not bls.Verify(pk, b"other", sig)
+with bls.deferred_verification():
+    assert bls.Verify(pk, msg, sig) is True
+agg = bls.AggregatePKs([bls.SkToPk(7), bls.SkToPk(8)])
+assert len(agg) == 48
+bls.clear_caches()  # must not import bls_jax (sys.modules.get guard)
+assert BLOCKED not in sys.modules
+print("PY-BACKEND-OK")
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert "PY-BACKEND-OK" in res.stdout

@@ -76,3 +76,45 @@ def test_mesh_rlc_pairing_check_matches_single_device():
     sharded_bad = pairing_check_rlc_mesh(mesh, *bad, zbits, p2_is_neg_g1=True)
     assert bool(np.asarray(single_bad)) is False
     assert bool(np.asarray(sharded_bad)) is False
+
+
+@pytest.mark.slow
+def test_mesh_rlc_grouped_matches_single_device():
+    """The SEGMENTED (distinct-message) randomized flush sharded over the
+    mesh: items split on N, the D distinct-message Miller loops split on D,
+    one Fp12-product collective at the tail. Must agree with the
+    single-device grouped kernel on a valid batch and a tampered one —
+    exactly (modular group/field arithmetic: the mesh's different reduce
+    association order cannot change any value)."""
+    from consensus_specs_tpu.crypto.bls_jax import (
+        bench_grouped_pairing_args, random_zbits,
+    )
+    from consensus_specs_tpu.parallel.collectives import (
+        pairing_check_rlc_grouped_mesh,
+    )
+
+    mesh = make_mesh(jax.devices()[:8])
+    n, d = 32, 8  # 4 items and 1 distinct-message Miller loop per device
+    (qx, qy, px, py, q2x, q2y), seg_ids = bench_grouped_pairing_args(n, d)
+    assert px.shape[0] == n and qx[0].shape[0] == d  # no padding at this shape
+    zbits = random_zbits(n)
+
+    single = K.pairing_check_rlc(qx, qy, px, py, q2x, q2y, None, None, zbits,
+                                 p2_is_neg_g1=True, seg_ids=seg_ids)
+    sharded = pairing_check_rlc_grouped_mesh(
+        mesh, qx, qy, px, py, q2x, q2y, zbits, seg_ids)
+    assert bool(np.asarray(single)) is True
+    assert bool(np.asarray(sharded)) is True
+
+    # wrong pubkey point on one item (x<->y swap): both paths must reject,
+    # even though the item hides inside a multi-member segment sum
+    px_bad = np.asarray(px).copy()
+    py_bad = np.asarray(py).copy()
+    px_bad[11], py_bad[11] = py_bad[11].copy(), px_bad[11].copy()
+    pxb, pyb = jax.numpy.asarray(px_bad), jax.numpy.asarray(py_bad)
+    single_bad = K.pairing_check_rlc(qx, qy, pxb, pyb, q2x, q2y, None, None,
+                                     zbits, p2_is_neg_g1=True, seg_ids=seg_ids)
+    sharded_bad = pairing_check_rlc_grouped_mesh(
+        mesh, qx, qy, pxb, pyb, q2x, q2y, zbits, seg_ids)
+    assert bool(np.asarray(single_bad)) is False
+    assert bool(np.asarray(sharded_bad)) is False

@@ -1,0 +1,187 @@
+"""Grouped (distinct-message) randomized batch verification.
+
+The segmented RLC fast path (ops/bls12_jax.pairing_check_rlc seg_ids=...)
+collapses the first pairing set by bilinearity per distinct message:
+D+1 Miller loops for D distinct messages instead of N+1. These tests pin
+
+1. the cost claim — exactly D+1 Miller loops at the acceptance shape
+   (N=128, D=8), asserted shape-only via jax.eval_shape (no compile);
+2. agreement — grouped kernel == ungrouped RLC == per-item
+   pairing_check_batch on the same logical checks, valid and tampered,
+   across a mix of group sizes (one large group, a medium one, singleton
+   all-distinct riders) and non-power-of-two n and d (padding path);
+3. the flush wiring — a deferred flush with repeated messages takes the
+   rlc_grouped path (LAST_FLUSH), and a wrong signature inside a
+   shared-message group still gets per-item attribution.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.crypto import bls, bls_sig
+from consensus_specs_tpu.crypto import bls12_381 as oracle
+
+
+@pytest.fixture(autouse=True)
+def _real_bls_then_restore():
+    prev_active, prev_backend = bls.bls_active, bls.backend()
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev_active
+    bls.use_py() if prev_backend == "py" else bls.use_jax()
+
+
+def _check_triples(items):
+    """[(sk, msg)] -> (p1s, q1s, q2s) affine host triples for the grouped
+    packer, mirroring make_verify_check's two-pairing normal form."""
+    from consensus_specs_tpu.crypto.bls_jax import g2_from_bytes, hash_to_curve_g2
+
+    p1s, q1s, q2s = [], [], []
+    for sk, msg in items:
+        p1s.append(
+            oracle.pt_to_affine(
+                oracle.FP_FIELD, oracle.pt_mul(oracle.FP_FIELD, oracle.G1_GEN, sk)
+            )
+        )
+        q1s.append(hash_to_curve_g2(msg))
+        q2s.append(g2_from_bytes(bytes(bls_sig.Sign(sk, msg))))
+    return p1s, q1s, q2s
+
+
+def test_grouped_flush_is_d_plus_1_miller_loops():
+    """Acceptance shape N=128 / D=8: the grouped fast path pays exactly 9
+    Miller loops. eval_shape over the kernel's OWN stage helpers — no
+    compile, so this stays in the fast tier."""
+    import jax
+
+    from consensus_specs_tpu.crypto.bls_jax import (
+        bench_grouped_pairing_args, random_zbits,
+    )
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    (qx, qy, px, py, q2x, q2y), seg_ids = bench_grouped_pairing_args(128, 8)
+    assert qx[0].shape[0] == 8 and px.shape[0] == 128
+    zbits = random_zbits(128)
+
+    def grouped_millers(px, py, zbits, seg_ids, qx, qy, q2x, q2y):
+        a1x, a1y = K.rlc_collapse_g1_by_message(px, py, zbits, seg_ids, 8)
+        m1 = K.miller_loop_batch(qx, qy, a1x, a1y)
+        aqx, aqy = K.rlc_collapse_g2(q2x, q2y, zbits)
+        ngx, ngy = K._neg_g1_affine_mont()
+        m2 = K.miller_loop_batch(aqx, aqy, ngx, ngy)
+        return m1, m2
+
+    m1, m2 = jax.eval_shape(
+        grouped_millers, px, py, zbits, seg_ids, qx, qy, q2x, q2y)
+    assert K.rlc_miller_loop_count(m1, m2) == 9
+
+    # the ungrouped path's first Miller stage at the same batch is N-wide:
+    # N+1 = 129 loops total (the q2 arrays stand in for full-width Q1 — only
+    # shapes matter under eval_shape)
+    def ungrouped_millers(px, py, zbits, q2x, q2y):
+        a1x, a1y = K.rlc_randomize_g1(px, py, zbits)
+        m1 = K.miller_loop_batch(q2x, q2y, a1x, a1y)
+        aqx, aqy = K.rlc_collapse_g2(q2x, q2y, zbits)
+        ngx, ngy = K._neg_g1_affine_mont()
+        m2 = K.miller_loop_batch(aqx, aqy, ngx, ngy)
+        return m1, m2
+
+    u1, u2 = jax.eval_shape(ungrouped_millers, px, py, zbits, q2x, q2y)
+    assert K.rlc_miller_loop_count(u1, u2) == 129
+
+
+@pytest.mark.slow
+def test_grouped_matches_ungrouped_and_per_item():
+    """Mixed group sizes + non-pow2 n and d: one message shared by 5 items,
+    one by 2, three singletons (n=10, d=5 -> pads to b_d=8, b_n=16).
+    Grouped and ungrouped RLC under the SAME z scalars and the per-item
+    batch kernel must all agree — on the valid batch and on a batch with a
+    wrong signature hidden inside the 5-member group."""
+    from consensus_specs_tpu.crypto.bls_jax import (
+        _NEG_G1, _pack_grouped_args, _pack_pairing_args, random_zbits,
+    )
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    items = [(100 + i, b"shared message A") for i in range(5)]
+    items += [(200 + i, b"shared message B") for i in range(2)]
+    items += [(300 + i, b"solo message %d" % i) for i in range(3)]
+    p1s, q1s, q2s = _check_triples(items)
+
+    def run_all(p1s, q1s, q2s):
+        n = len(p1s)
+        b_n, b_d, gargs, seg_ids = _pack_grouped_args(p1s, q1s, q2s)
+        assert (b_n, b_d) == (16, 8)  # padding engaged: n=10->16, d=5->8
+        zbits = random_zbits(b_n)
+        grouped = bool(np.asarray(K.pairing_check_rlc(
+            *gargs, None, None, zbits, p2_is_neg_g1=True, seg_ids=seg_ids)))
+        # ungrouped RLC over the SAME items and the SAME z_i: both packers
+        # keep original item order and pad at the tail, so zbits line up
+        b, uargs = _pack_pairing_args(p1s, q1s, [_NEG_G1] * n, q2s)
+        assert b == b_n
+        ungrouped = bool(np.asarray(K.pairing_check_rlc(
+            *uargs, zbits, p2_is_neg_g1=True)))
+        per_item = np.asarray(K.pairing_check_batch(*uargs))[:n]
+        return grouped, ungrouped, per_item
+
+    grouped, ungrouped, per_item = run_all(p1s, q1s, q2s)
+    assert grouped and ungrouped and per_item.all()
+
+    # wrong signature inside the shared-message group: sk 102 signs A but
+    # the batch carries sk 103's signature at index 2
+    bad_q2s = list(q2s)
+    bad_q2s[2] = q2s[3]
+    grouped, ungrouped, per_item = run_all(p1s, q1s, bad_q2s)
+    assert not grouped and not ungrouped
+    want = np.ones(len(items), dtype=bool)
+    want[2] = False
+    assert (per_item == want).all()  # per-item attribution localizes it
+
+    # tamper a singleton group too: the segment reduce must not smear
+    # failures across groups
+    bad_q2s = list(q2s)
+    bad_q2s[8] = q2s[9]
+    grouped, ungrouped, per_item = run_all(p1s, q1s, bad_q2s)
+    assert not grouped and not ungrouped
+    assert not per_item[8] and per_item[np.arange(10) != 8].all()
+
+
+@pytest.mark.slow
+def test_grouped_deferred_flush_path_and_attribution():
+    """run_checks routing: a >=RLC_MIN_BATCH flush with repeated messages
+    takes the grouped kernel (LAST_FLUSH says so, with the D+1 bill), an
+    all-distinct flush keeps the ungrouped kernel, and a wrong signature
+    inside a shared-message group is attributed per item at flush."""
+    from consensus_specs_tpu.crypto import bls_jax
+
+    n = bls_jax.RLC_MIN_BATCH
+    triples = []
+    for i in range(n):
+        sk, msg = 500 + i, b"flush message %d" % (i % 4)
+        triples.append((bls_sig.SkToPk(sk), msg, bls_sig.Sign(sk, msg)))
+
+    bls.use_jax()
+    with bls.deferred_verification():
+        for pk, msg, sig in triples:
+            assert bls.Verify(pk, msg, sig) is True
+    assert bls_jax.LAST_FLUSH["path"] == "rlc_grouped"
+    assert bls_jax.LAST_FLUSH["distinct"] == 4
+    assert bls_jax.LAST_FLUSH["miller_loops"] == 5  # D+1, not N+1
+
+    # all-distinct messages: the segment reduce would be pure overhead,
+    # the flush must keep the ungrouped kernel
+    distinct_triples = []
+    for i in range(n):
+        sk, msg = 700 + i, b"all distinct %d" % i
+        distinct_triples.append((bls_sig.SkToPk(sk), msg, bls_sig.Sign(sk, msg)))
+    with bls.deferred_verification():
+        for pk, msg, sig in distinct_triples:
+            bls.Verify(pk, msg, sig)
+    assert bls_jax.LAST_FLUSH["path"] == "rlc"
+
+    # wrong signature inside a shared-message group: batch fails, per-item
+    # fallback names the culprit index
+    with pytest.raises(bls.BLSVerificationError) as exc:
+        with bls.deferred_verification():
+            for i, (pk, msg, sig) in enumerate(triples):
+                bls.Verify(pk, msg, triples[(i + 1) % n][2] if i == 6 else sig)
+    assert bls_jax.LAST_FLUSH["path"] == "rlc_grouped"
+    assert "6" in str(exc.value)
