@@ -56,11 +56,15 @@ ifeq ($(ARTIFACTS),1)
 endif
 
 # Static gate: compile-check + AST lint (unused imports, import shadowing,
-# mutable defaults, tuple asserts, bare excepts). The reference's
-# flake8+mypy role (linter.ini) — those tools are not in this image.
+# mutable defaults, tuple asserts, bare excepts) + tpulint (JAX hot-path
+# invariants: jit purity, dtype pinning, donation aliasing, import layering,
+# scatter bans — see BASELINE.md). The reference's flake8+mypy role
+# (linter.ini) — those tools are not in this image.
 lint: pyspec
 	$(PYTHON) tools/lint.py
 	$(PYTHON) tools/typegate.py
+	$(PYTHON) tools/tpulint.py consensus_specs_tpu --baseline tpulint_baseline.json
+	$(PYTHON) tools/tpulint.py --self-test
 
 # Regenerate the checked-in randomized test module (reference:
 # tests/generators/random/generate.py workflow).

@@ -1,0 +1,19 @@
+"""tpulint — AST-based invariant checker for the JAX hot path.
+
+The reference consensus-specs repo ships its own correctness tooling (spec
+compiler checks, custom lint targets) because the markdown *is* the code.
+This package is the analogous layer for the TPU port: every hard-won
+kernel-boundary invariant (int32-pinned loop bounds, owning reads at the
+donation boundary, jax-free py-branches, the no-scatter reduction rule) is
+enforced statically as a named, suppressible rule instead of by tribal
+knowledge plus a regression test that fires after the miscompile.
+
+Stdlib-only by design: the analyzer itself must run in a jax-free process
+(CI lint lanes, pre-commit hooks) and must never pay a device-runtime import
+to inspect source text.
+
+Entry points: tools/tpulint.py (CLI), `make lint`, and
+tests/test_tpulint.py::test_package_clean (tier-1).
+"""
+from .core import Finding, Module, collect_modules  # noqa: F401
+from .runner import ALL_RULES, analyze_paths, rule_by_id  # noqa: F401

@@ -1,0 +1,76 @@
+"""Baseline machinery: freeze pre-existing findings, fail only on NEW ones.
+
+The baseline file (tpulint_baseline.json, repo root) is a reviewed artifact:
+it holds every finding present when the rule landed, plus a `budget` — the
+frozen total. CI enforces two directions:
+
+  * the current run may not introduce findings beyond the baseline
+    (count-based per (path, rule), so unrelated line drift in a file does
+    not fire false positives while any genuinely new violation does);
+  * the FILE may never grow: regenerating is only allowed to shrink it
+    (`budget` ratchets monotonically down; tools/tpulint.py
+    --write-baseline refuses growth without --allow-growth, and
+    tests/test_tpulint.py::test_baseline_never_grows holds the ratchet).
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: Path) -> dict:
+    data = json.loads(path.read_text())
+    assert data.get("version") == BASELINE_VERSION, \
+        f"unknown baseline version in {path}"
+    return data
+
+
+def write_baseline(findings: list[Finding], path: Path, budget: int) -> dict:
+    data = {
+        "version": BASELINE_VERSION,
+        "budget": budget,
+        "findings": [f.as_json() for f in sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule))],
+    }
+    path.write_text(json.dumps(data, indent=1) + "\n")
+    return data
+
+
+def _group(entries) -> Counter:
+    return Counter((e["path"] if isinstance(e, dict) else e.path,
+                    e["rule"] if isinstance(e, dict) else e.rule)
+                   for e in entries)
+
+
+def diff_against_baseline(findings: list[Finding], baseline: dict
+                          ) -> tuple[list[Finding], int]:
+    """(new_findings, fixed_count). A finding is NEW when its (path, rule)
+    group has more members than the baseline recorded; the reported nodes are
+    the ones on lines the baseline has never seen (else the trailing extras),
+    so the printed line numbers point at the most plausible culprit."""
+    base_groups = _group(baseline.get("findings", []))
+    base_lines = {(e["path"], e["rule"], e["line"])
+                  for e in baseline.get("findings", [])}
+    cur_groups: dict[tuple, list[Finding]] = {}
+    for f in findings:
+        cur_groups.setdefault((f.path, f.rule), []).append(f)
+
+    new: list[Finding] = []
+    for key, group in cur_groups.items():
+        allowed = base_groups.get(key, 0)
+        excess = len(group) - allowed
+        if excess <= 0:
+            continue
+        unseen = [f for f in group if (f.path, f.rule, f.line) not in base_lines]
+        pick = unseen if len(unseen) >= excess else group
+        new.extend(sorted(pick, key=lambda f: f.line)[-excess:]
+                   if len(pick) > excess else pick)
+
+    cur_counter = _group(findings)
+    fixed = sum((base_groups - cur_counter).values())
+    return sorted(new, key=lambda f: (f.path, f.line, f.rule)), fixed

@@ -1,0 +1,188 @@
+"""tpulint core: finding/module records, suppression parsing, file walking.
+
+Suppression syntax (one line, justification required after `--` by
+convention, mirrored from the repo's `# noqa` usage in tools/lint.py):
+
+    x = jnp.zeros(n)  # tpulint: disable=dtype-pin -- trace-time table, f32 ok
+    y = harmless()    # tpulint: disable -- blanket (all rules) on this line
+
+A file whose first five lines contain `# tpulint: skip-file` is excluded
+entirely (used for vendored sources, never inside the package).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+SEVERITIES = ("error", "warning")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, carrying enough to print, baseline, and fix."""
+
+    path: str  # posix-style path as scanned (repo-relative in CI)
+    line: int
+    rule: str
+    severity: str
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.severity}] {self.rule}: {self.message}"
+        if self.hint:
+            out += f"  (fix: {self.hint})"
+        return out
+
+    def as_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+@dataclass
+class Module:
+    """One parsed source file plus the names tpulint needs repeatedly."""
+
+    path: Path
+    rel: str  # posix path relative to the scan invocation (stable for baselines)
+    name: str  # dotted module name relative to the scan root's parent
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+    # line -> suppressed rule ids ("*" = all rules)
+    suppressions: dict[int, set[str]] = field(default_factory=dict)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+
+def parse_suppressions(lines: list[str]) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        if "tpulint:" not in text:
+            continue
+        _, _, tail = text.partition("tpulint:")
+        tail = tail.strip()
+        if tail.startswith("skip-file"):
+            continue  # handled at file level
+        if not tail.startswith("disable"):
+            continue
+        tail = tail[len("disable"):]
+        tail = tail.split("--", 1)[0].strip()  # drop the justification
+        if tail.startswith("="):
+            rules = {r.strip() for r in tail[1:].split(",") if r.strip()}
+        else:
+            rules = {"*"}
+        out.setdefault(i, set()).update(rules)
+    return out
+
+
+def _skip_file(lines: list[str]) -> bool:
+    return any("tpulint: skip-file" in text for text in lines[:5])
+
+
+def make_module(path: Path, rel: str, name: str) -> Module | None:
+    """Parse one file; returns None for skip-file'd sources. Raises
+    SyntaxError upward — the runner converts it into a `syntax-error`
+    finding so a broken file fails lint rather than silently dropping out
+    of analysis."""
+    source = path.read_text()
+    lines = source.splitlines()
+    if _skip_file(lines):
+        return None
+    tree = ast.parse(source, filename=str(path))
+    return Module(
+        path=path, rel=rel, name=name, source=source, tree=tree,
+        lines=lines, suppressions=parse_suppressions(lines),
+    )
+
+
+def collect_modules(root: Path) -> tuple[list[Module], list[Finding]]:
+    """Walk a scan root (package dir or single file) into Modules.
+
+    `rel` keeps the caller's spelling of the root (so baseline paths are
+    repo-relative when the CLI runs from the repo root, and path-scoped rules
+    still see `ops/` in a fixture path like tests/fixtures/.../ops/x.py).
+    Dotted names are rooted at the scan root itself (`consensus_specs_tpu/`
+    -> `consensus_specs_tpu.ops.shuffle`), so the layering DAG and the
+    fixture mini-packages resolve identically."""
+    root_rel = root.as_posix().rstrip("/")
+    if root.is_file():
+        pairs = [(root, root_rel, (root.name,))]
+    else:
+        pairs = [
+            (f, f"{root_rel}/{f.relative_to(root).as_posix()}",
+             (root.name, *f.relative_to(root).parts))
+            for f in sorted(root.rglob("*.py"))
+            if "__pycache__" not in f.parts
+        ]
+    errors: list[Finding] = []
+    out: list[Module] = []
+    for f, rel, name_parts in pairs:
+        dotted_name = ".".join(name_parts)[: -len(".py")]
+        if dotted_name.endswith(".__init__"):
+            dotted_name = dotted_name[: -len(".__init__")]
+        try:
+            mod = make_module(f, rel, dotted_name)
+        except SyntaxError as e:
+            errors.append(Finding(
+                path=rel, line=e.lineno or 1, rule="syntax-error",
+                severity="error", message=f"syntax error: {e.msg}"))
+            continue
+        if mod is not None:
+            out.append(mod)
+    return out, errors
+
+
+# --- shared AST helpers -------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`jax.lax.fori_loop` -> "jax.lax.fori_loop"; None for non-name chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted(node.func)
+
+
+def import_aliases(tree: ast.Module, roots: tuple[str, ...]) -> set[str]:
+    """Local names bound to any of `roots` (e.g. numpy -> {np}), including
+    `from jax import numpy as jnp` style bindings."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in roots:
+                    out.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            base = (node.module or "").split(".")[0]
+            for alias in node.names:
+                if base in roots or alias.name in roots:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def path_matches(rel: str, pattern: str) -> bool:
+    """Root-agnostic path matching so rule scopes apply equally to
+    `consensus_specs_tpu/ops/...` and fixture trees `.../ops/...`:
+    a trailing-slash pattern matches a directory segment anywhere; otherwise
+    the pattern must be a suffix aligned on a path boundary."""
+    rel = "/" + rel
+    if pattern.endswith("/"):
+        return f"/{pattern}" in rel + "/"
+    return rel.endswith("/" + pattern)

@@ -1,0 +1,131 @@
+"""Rule `donation-alias`: no reads of a buffer after it was donated to jit.
+
+The incident behind this rule (PR 1, CHANGES.md "device boundary hardened"):
+epoch dispatches with `donate_argnums` were allowed to scribble the
+memoized diff-base columns because host code kept reading an array it had
+already handed to a donating jit call — XLA is free to reuse the donated
+buffer for outputs, so such reads return garbage non-deterministically (and
+only on the platforms/layouts where reuse actually happens, which is why it
+escaped CPU tests).
+
+Static approximation (deliberately same-scope, matching the incident): in
+each function/module scope, a name passed at a donated position to a call of
+a `jax.jit(..., donate_argnums=...)`-built callable is tainted from that
+statement on; any later Load of the name before a rebinding is an error.
+Cross-scope flows (factory returns a donating callable used elsewhere) are
+out of static reach and stay covered by the owning-copy convention at the
+bridge (engine/bridge.py).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, call_name
+
+RULE_ID = "donation-alias"
+HINT = ("copy before the call (np.asarray/​jnp.array) or rebind the name from "
+        "the call's result; donated buffers may be reused for outputs")
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """donate_argnums from a jax.jit(...) call, if statically constant."""
+    name = call_name(call)
+    if name is None or name.split(".")[-1] not in ("jit", "pjit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                        return None
+                    out.append(e.value)
+                return tuple(out)
+            return None
+    return None
+
+
+def _ordered_nodes(stmts):
+    """Source-order traversal of a statement list, not descending into nested
+    function/class scopes (they are separate scopes for this rule).
+    Assignment values are yielded before their targets, matching evaluation
+    order, so `cols = step(cols)` taints and immediately rebinds."""
+    stack = list(reversed(stmts))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Assign):
+            children = [node.value, *node.targets]
+        elif isinstance(node, ast.AnnAssign):
+            children = [c for c in (node.value, node.target) if c is not None]
+        elif isinstance(node, ast.AugAssign):
+            children = [node.value, node.target]
+        else:
+            children = list(ast.iter_child_nodes(node))
+        stack.extend(reversed(children))
+
+
+class DonationAliasRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "no read of a variable after it was passed to a donate_argnums jit call"
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        scopes: list[list] = [mod.tree.body]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            findings.extend(self._check_scope(mod, body))
+        return findings
+
+    def _check_scope(self, mod: Module, body: list) -> list[Finding]:
+        # pass 1: names bound to donating jitted callables in this scope
+        donators: dict[str, tuple[int, ...]] = {}
+        for node in _ordered_nodes(body):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                pos = _donated_positions(node.value)
+                if pos is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donators[t.id] = pos
+
+        # pass 2: taint donated args, flag later loads, clear on rebind
+        findings: list[Finding] = []
+        tainted: dict[str, int] = {}  # name -> donation line
+        exempt: set[int] = set()  # id() of Name nodes that ARE the donated args
+        for node in _ordered_nodes(body):
+            if isinstance(node, ast.Call):
+                pos: tuple[int, ...] | None = None
+                if isinstance(node.func, ast.Name) and node.func.id in donators:
+                    pos = donators[node.func.id]
+                elif isinstance(node.func, ast.Call):
+                    # direct form: jax.jit(f, donate_argnums=(0,))(x)
+                    pos = _donated_positions(node.func)
+                if pos:
+                    for p in pos:
+                        if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                            arg = node.args[p]
+                            tainted[arg.id] = node.lineno
+                            exempt.add(id(arg))
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    if node.id in tainted and id(node) not in exempt:
+                        findings.append(Finding(
+                            path=mod.rel, line=node.lineno, rule=self.id,
+                            severity="error",
+                            message=f"read of '{node.id}' after it was donated "
+                                    f"to a jit call on line {tainted[node.id]} "
+                                    "(buffer may be reused for outputs)",
+                            hint=HINT))
+                        del tainted[node.id]  # one finding per donation
+                elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                    tainted.pop(node.id, None)
+        return findings

@@ -1,0 +1,103 @@
+"""Rule `dtype-pin`: explicit dtypes on constructors and loop bounds in
+kernel code (`ops/`, `parallel/`).
+
+The incident behind this rule (PR 1, CHANGES.md): `fori_loop` bounds left as
+bare Python ints traced as s64 under x64 mode while the loop carry stayed
+s32, and the GSPMD partitioner rejected (and on one path miscompiled) the
+mixed-width loop on sharded programs. ops/sha256_jax.py's
+`fori_loop(jnp.int32(16), jnp.int32(64), ...)` is the sanctioned spelling.
+
+Two checks, both error severity inside the kernel directories:
+
+  * `jnp.arange/zeros/ones/full/empty` without an explicit dtype (keyword or
+    the documented positional slot) — the ambient default dtype flips with
+    x64 mode, so an unpinned constructor is a different program per process
+    config. `*_like` variants and `jnp.asarray` inherit and are exempt.
+  * `lax.fori_loop(lower, upper, ...)` where either bound is a bare int
+    literal or any expression not visibly pinned (jnp/np integer-dtype
+    constructor call, or `.astype(...)`).
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, call_name, import_aliases, path_matches
+
+RULE_ID = "dtype-pin"
+SCOPE = ("ops/", "parallel/")
+
+_CTOR_DTYPE_SLOT = {"zeros": 1, "ones": 1, "empty": 1, "arange": 3, "full": 2}
+_INT_PIN_CTORS = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+}
+
+
+def _is_pinned_bound(node: ast.AST, num_aliases: set[str]) -> bool:
+    """jnp.int32(x) / np.uint32(x) / (...).astype(...) count as pinned."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    if name is not None:
+        parts = name.split(".")
+        if parts[-1] in _INT_PIN_CTORS and (len(parts) == 1 or parts[0] in num_aliases):
+            return True
+        if parts[-1] in ("asarray", "array"):
+            return any(kw.arg == "dtype" for kw in node.keywords)
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+        return True
+    return False
+
+
+class DtypePinRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "explicit dtypes on jnp constructors and fori_loop bounds in ops//parallel/"
+
+    def __init__(self, scope: tuple[str, ...] = SCOPE):
+        self.scope = scope
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not any(path_matches(mod.rel, p) for p in self.scope):
+            return []
+        # constructors are only flagged on jax.numpy bindings (host np tables
+        # keep numpy's x64-independent defaults); bound pins accept np too
+        jnp_aliases = import_aliases(mod.tree, ("jax",))
+        pin_aliases = jnp_aliases | import_aliases(mod.tree, ("numpy",))
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if (len(parts) == 2 and parts[0] in jnp_aliases
+                    and parts[1] in _CTOR_DTYPE_SLOT):
+                slot = _CTOR_DTYPE_SLOT[parts[1]]
+                has_dtype = (any(kw.arg == "dtype" for kw in node.keywords)
+                             or len(node.args) > slot)
+                if not has_dtype:
+                    findings.append(Finding(
+                        path=mod.rel, line=node.lineno, rule=self.id,
+                        severity="error",
+                        message=f"'{name}(...)' without an explicit dtype "
+                                "(ambient default flips with x64 mode)",
+                        hint=f"pass dtype= to {name}",
+                    ))
+            elif parts[-1] == "fori_loop":
+                for label, bound in zip(("lower", "upper"), node.args[:2]):
+                    if _is_pinned_bound(bound, pin_aliases):
+                        continue
+                    literal = (isinstance(bound, ast.Constant)
+                               and isinstance(bound.value, int))
+                    what = ("bare int literal" if literal
+                            else "unpinned expression")
+                    findings.append(Finding(
+                        path=mod.rel, line=bound.lineno, rule=self.id,
+                        severity="error",
+                        message=f"fori_loop {label} bound is a {what} "
+                                "(s64/s32 mixed-width loop under x64: the "
+                                "PR-1 GSPMD verifier failure class)",
+                        hint="wrap the bound in jnp.int32(...) like ops/sha256_jax.py",
+                    ))
+        return findings

@@ -1,0 +1,157 @@
+"""Rule `jit-purity`: no host effects reachable inside jit-traced code.
+
+`print(...)`, `open(...)`, `.item()`/`.tolist()` and `np.*` calls inside a
+`@jax.jit`-decorated or `shard_map`-wrapped function run at TRACE time, not
+per call: a print appears to work exactly once and then silently never fires
+again; `.item()` forces a device→host sync inside the hot path; a numpy call
+on a traced value either crashes at trace or constant-folds the tracer.
+
+Severities: print/open/.item()/.tolist() are errors (always a bug or a
+debugging leftover); `np.*` calls are warnings — numpy on *static* values at
+trace time (twiddle tables, bit-reversal permutations) is a sanctioned
+pattern, so legitimate uses carry a suppression with justification or live
+in the baseline. np dtype constructors (np.int32(...) etc.) are exempt:
+they are the pinning pattern the dtype-pin rule prescribes.
+
+Reachability is the intra-module call graph: a function is jit-traced if it
+is decorated with jit, passed to jax.jit/pjit/shard_map as a function
+reference, or called (by name) from a jit-traced function — the
+`_ntt_impl`-style helper layering ops/ uses everywhere.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, call_name, dotted, import_aliases
+
+RULE_ID = "jit-purity"
+HINT = ("move host effects outside the jitted function (jax.debug.print / "
+        "jax.debug.callback for diagnostics); keep np to trace-time statics "
+        "and suppress with a justification")
+
+_JIT_NAMES = {"jit", "pjit"}
+_WRAP_NAMES = {"jit", "pjit", "shard_map"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_NP_DTYPE_CTORS = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64", "bool_", "dtype",
+}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    """jax.jit / jit / pjit / jax.experimental.pjit as a bare reference."""
+    name = dotted(node)
+    return name is not None and name.split(".")[-1] in _JIT_NAMES
+
+
+def _is_wrap_call(node: ast.Call) -> bool:
+    """jax.jit(...) / pjit(...) / shard_map(...) / partial(jax.jit, ...)."""
+    name = call_name(node)
+    if name is not None and name.split(".")[-1] in _WRAP_NAMES:
+        return True
+    if name is not None and name.split(".")[-1] == "partial" and node.args:
+        return _is_jit_ref(node.args[0])
+    return False
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """name -> [FunctionDef] for every def in the module (scope-flattened:
+    by-name resolution is deliberately conservative — a collision unions the
+    candidates, which can only over-approximate reachability)."""
+
+    def __init__(self):
+        self.defs: dict[str, list[ast.AST]] = {}
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _jit_roots(tree: ast.Module, defs: dict[str, list[ast.AST]]) -> list[ast.AST]:
+    roots: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_ref(deco) or (isinstance(deco, ast.Call) and _is_wrap_call(deco)):
+                    roots.append(node)
+        elif isinstance(node, ast.Call) and _is_wrap_call(node):
+            # jax.jit(fn, ...) / shard_map(fn, mesh, ...): fn by local name
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    roots.extend(defs[arg.id])
+    return roots
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            out.add(node.func.id)
+        elif isinstance(node, ast.Call):
+            # higher-order plumbing: fori_loop(..., body, ...) / cond / scan /
+            # while_loop take function references as arguments
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _reachable(roots: list[ast.AST], defs: dict[str, list[ast.AST]]) -> list[ast.AST]:
+    seen: dict[int, ast.AST] = {}
+    work = list(roots)
+    while work:
+        fn = work.pop()
+        if id(fn) in seen:
+            continue
+        seen[id(fn)] = fn
+        for name in _called_names(fn):
+            for cand in defs.get(name, ()):
+                if id(cand) not in seen:
+                    work.append(cand)
+    return list(seen.values())
+
+
+class JitPurityRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "no print/open/.item()/np.* host calls reachable inside jit-traced code"
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        index = _FuncIndex()
+        index.visit(mod.tree)
+        roots = _jit_roots(mod.tree, index.defs)
+        if not roots:
+            return []
+        np_aliases = import_aliases(mod.tree, ("numpy",))
+        findings: dict[tuple[int, str], Finding] = {}
+
+        def emit(line: int, severity: str, message: str):
+            findings.setdefault((line, message), Finding(
+                path=mod.rel, line=line, rule=self.id,
+                severity=severity, message=message, hint=HINT))
+
+        for fn in _reachable(roots, index.defs):
+            fname = getattr(fn, "name", "<fn>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name in ("print", "open", "input", "breakpoint"):
+                    emit(node.lineno, "error",
+                         f"{name}() reachable inside jit-traced '{fname}' "
+                         "(runs at trace time only)")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _HOST_SYNC_METHODS):
+                    emit(node.lineno, "error",
+                         f".{node.func.attr}() reachable inside jit-traced "
+                         f"'{fname}' (forces device->host sync)")
+                elif name is not None and name.split(".")[0] in np_aliases:
+                    attr = name.split(".")[-1]
+                    if attr in _NP_DTYPE_CTORS:
+                        continue  # dtype pins are the sanctioned pattern
+                    emit(node.lineno, "warning",
+                         f"numpy call '{name}' reachable inside jit-traced "
+                         f"'{fname}' (host compute; fine only on trace-time statics)")
+        return sorted(findings.values(), key=lambda f: f.line)

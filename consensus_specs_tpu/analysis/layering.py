@@ -1,0 +1,229 @@
+"""Rule `import-layering`: the declared module DAG, checked from real imports.
+
+Three families of constraints, configured as root-agnostic path patterns so
+the same rule runs over `consensus_specs_tpu/` and the fixture mini-packages:
+
+  * jax-free py-branches: `evm/` and the crypto host path (`crypto/bls.py`,
+    `crypto/kzg.py`, `crypto/kzg_shim.py`, `crypto/das.py`) must be importable
+    with jax unimportable — no module-level `jax`/`bls_jax` import, direct OR
+    transitive through package-internal module-level imports (the PR-3
+    deferred-import discipline; the poisoned-module subprocess tests are the
+    runtime twin of this static check).
+  * layer order: `ops/` (leaf kernels) never imports `engine/` (orchestration).
+  * test-only code: `testlib/` is importable only from `spec_tests/` (and
+    itself) — never from production modules.
+
+Module-level means any import statement outside a def; imports inside
+`if TYPE_CHECKING:` blocks are exempt (annotation-only).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Finding, Module, path_matches
+
+RULE_ID = "import-layering"
+
+
+@dataclass(frozen=True)
+class LayeringConfig:
+    # path patterns (see core.path_matches) that must stay jax-free at import
+    jax_free: tuple[str, ...] = (
+        "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
+        "crypto/das.py",
+    )
+    # (importer pattern, forbidden import pattern) over module paths
+    forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)
+    test_only: tuple[str, ...] = ("testlib/",)
+    test_consumers: tuple[str, ...] = ("testlib/", "spec_tests/")
+    # external import roots that count as "jax"
+    jax_roots: tuple[str, ...] = ("jax", "jaxlib")
+    # package-internal module basenames that imply jax regardless of content
+    jax_basenames: tuple[str, ...] = ("bls_jax",)
+
+
+@dataclass
+class _ImportEdge:
+    target: str  # resolved dotted module name (internal) or external root
+    internal: bool
+    line: int
+    module_level: bool
+
+
+def _resolve_relative(mod_name: str, level: int, target: str | None) -> str:
+    parts = mod_name.split(".")
+    base = parts[: len(parts) - level] if level <= len(parts) else []
+    return ".".join(base + (target.split(".") if target else []))
+
+
+def _iter_module_level_stmts(tree: ast.Module):
+    """Top-level statements plus bodies of top-level If/Try/With (guarded
+    imports still execute at import time), excluding `if TYPE_CHECKING:`."""
+    work = list(tree.body)
+    while work:
+        stmt = work.pop()
+        yield stmt
+        if isinstance(stmt, ast.If):
+            test = stmt.test
+            tname = test.attr if isinstance(test, ast.Attribute) else getattr(test, "id", None)
+            if tname == "TYPE_CHECKING":
+                work.extend(stmt.orelse)
+                continue
+            work.extend(stmt.body + stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            work.extend(stmt.body + stmt.orelse + stmt.finalbody)
+            for h in stmt.handlers:
+                work.extend(h.body)
+        elif isinstance(stmt, ast.With):
+            work.extend(stmt.body)
+
+
+def _edges(mod: Module, names: set[str]) -> list[_ImportEdge]:
+    module_level_ids = set()
+    for stmt in _iter_module_level_stmts(mod.tree):
+        # do NOT descend into defs: an import inside a function body is the
+        # sanctioned deferral, even when the def is a top-level statement
+        work = [stmt]
+        while work:
+            node = work.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                module_level_ids.add(id(node))
+            work.extend(ast.iter_child_nodes(node))
+
+    def resolve(raw: str) -> tuple[str, bool]:
+        """Longest package-internal prefix match, else external root."""
+        parts = raw.split(".")
+        for i in range(len(parts), 0, -1):
+            cand = ".".join(parts[:i])
+            if cand in names:
+                return cand, True
+        return parts[0], False
+
+    out: list[_ImportEdge] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                target, internal = resolve(alias.name)
+                out.append(_ImportEdge(target, internal, node.lineno,
+                                       id(node) in module_level_ids))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            base = (_resolve_relative(mod.name, node.level, node.module)
+                    if node.level else (node.module or ""))
+            for alias in node.names:
+                raw = f"{base}.{alias.name}" if base else alias.name
+                target, internal = resolve(raw)
+                if not internal and node.level:
+                    continue  # relative import that resolves outside the scan
+                out.append(_ImportEdge(target, internal, node.lineno,
+                                       id(node) in module_level_ids))
+    return out
+
+
+class ImportLayeringRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "declared module DAG: jax-free py-branches, ops!->engine, testlib test-only"
+
+    def __init__(self, config: LayeringConfig | None = None):
+        self.config = config or LayeringConfig()
+
+    def check_project(self, mods: list[Module]) -> list[Finding]:
+        cfg = self.config
+        by_name = {m.name: m for m in mods}
+        names = set(by_name)
+        edges = {m.name: _edges(m, names) for m in mods}
+
+        # --- transitive module-level jax taint over internal edges ----------
+        def direct_jax(mname: str) -> _ImportEdge | None:
+            for e in edges[mname]:
+                if not e.module_level:
+                    continue
+                if not e.internal and e.target in cfg.jax_roots:
+                    return e
+                if e.internal and e.target.split(".")[-1] in cfg.jax_basenames:
+                    return e
+            return None
+
+        taint: dict[str, list[str]] = {}  # module -> chain of names to jax
+
+        def taint_chain(mname: str, seen: frozenset[str]) -> list[str] | None:
+            if mname in taint:
+                return taint[mname]
+            if direct_jax(mname) is not None:
+                chain = [mname, "jax"]
+                taint[mname] = chain
+                return chain
+            for e in edges.get(mname, ()):
+                if not (e.internal and e.module_level) or e.target in seen:
+                    continue
+                if e.target == mname or e.target not in edges:
+                    continue
+                sub = taint_chain(e.target, seen | {mname})
+                if sub is not None:
+                    chain = [mname] + sub
+                    taint[mname] = chain
+                    return chain
+            return None
+
+        findings: list[Finding] = []
+        for m in mods:
+            if not any(path_matches(m.rel, p) for p in cfg.jax_free):
+                continue
+            chain = taint_chain(m.name, frozenset())
+            if chain is None:
+                continue
+            if len(chain) == 2:  # direct
+                e = direct_jax(m.name)
+                findings.append(Finding(
+                    path=m.rel, line=e.line, rule=self.id, severity="error",
+                    message=f"module-level '{e.target}' import in a jax-free "
+                            "py-branch module",
+                    hint="defer the import into the jax branch "
+                         "(crypto/bls.py pattern; PR-3 discipline)"))
+            else:
+                first = next(e for e in edges[m.name]
+                             if e.internal and e.module_level and e.target == chain[1])
+                findings.append(Finding(
+                    path=m.rel, line=first.line, rule=self.id, severity="error",
+                    message="jax reachable from a jax-free py-branch module "
+                            f"via module-level imports: {' -> '.join(chain)}",
+                    hint="defer the first hop into the jax branch or move the "
+                         "needed host helpers to a jax-free module "
+                         "(ops/fr_host.py pattern)"))
+
+        # --- forbidden layer edges (any import, even deferred) ---------------
+        for m in mods:
+            for src_pat, dst_pat in cfg.forbidden:
+                if not path_matches(m.rel, src_pat):
+                    continue
+                for e in edges[m.name]:
+                    if e.internal and e.target in by_name and \
+                            path_matches(by_name[e.target].rel, dst_pat):
+                        findings.append(Finding(
+                            path=m.rel, line=e.line, rule=self.id,
+                            severity="error",
+                            message=f"layer violation: '{src_pat}' must not "
+                                    f"import '{dst_pat}' (imports {e.target})",
+                            hint="invert the dependency: engine/ composes ops/ "
+                                 "kernels, never the reverse"))
+
+        # --- test-only modules ------------------------------------------------
+        for m in mods:
+            if any(path_matches(m.rel, p) for p in cfg.test_consumers):
+                continue
+            for e in edges[m.name]:
+                if e.internal and e.target in by_name and \
+                        any(path_matches(by_name[e.target].rel, p)
+                            for p in cfg.test_only):
+                    findings.append(Finding(
+                        path=m.rel, line=e.line, rule=self.id, severity="error",
+                        message=f"test-only module '{e.target}' imported from "
+                                "production code",
+                        hint="testlib/ is for tests and spec_tests/ only; lift "
+                             "shared helpers into the production package"))
+        return findings

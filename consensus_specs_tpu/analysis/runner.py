@@ -1,0 +1,83 @@
+"""tpulint driver: collect modules, run all rule passes, apply suppressions.
+
+Per-module rules implement `check_module(Module)`; project rules (the import
+DAG) implement `check_project(list[Module])` and run once over the whole
+scan so transitive-import chains resolve. Suppressed findings are dropped
+here (and counted), so every front-end — CLI, pytest integration, baseline
+writer — sees the same post-suppression stream.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import Finding, Module, collect_modules
+from .donation import DonationAliasRule
+from .dtype_pins import DtypePinRule
+from .jit_purity import JitPurityRule
+from .layering import ImportLayeringRule
+from .scatter import NoScatterRule
+
+ALL_RULES = (
+    JitPurityRule(),
+    DtypePinRule(),
+    DonationAliasRule(),
+    ImportLayeringRule(),
+    NoScatterRule(),
+)
+
+
+def rule_by_id(rule_id: str):
+    for rule in ALL_RULES:
+        if rule.id == rule_id:
+            return rule
+    raise KeyError(f"unknown rule '{rule_id}' "
+                   f"(known: {', '.join(r.id for r in ALL_RULES)})")
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    file_count: int = 0
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+
+def run_rules(mods: list[Module], rules=ALL_RULES) -> tuple[list[Finding], int]:
+    raw: list[Finding] = []
+    for rule in rules:
+        check_module = getattr(rule, "check_module", None)
+        if check_module is not None:
+            for mod in mods:
+                raw.extend(check_module(mod))
+        check_project = getattr(rule, "check_project", None)
+        if check_project is not None:
+            raw.extend(check_project(mods))
+
+    by_rel = {m.rel: m for m in mods}
+    kept, suppressed = [], 0
+    for f in raw:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.suppressed(f.line, f.rule):
+            suppressed += 1
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept, suppressed
+
+
+def analyze_paths(paths: list[str | Path], rules=ALL_RULES) -> AnalysisResult:
+    mods: list[Module] = []
+    findings: list[Finding] = []
+    for p in paths:
+        collected, syntax_errors = collect_modules(Path(p))
+        mods.extend(collected)
+        findings.extend(syntax_errors)  # never suppressible
+    kept, suppressed = run_rules(mods, rules)
+    findings.extend(kept)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return AnalysisResult(findings=findings, suppressed=suppressed,
+                          file_count=len(mods))

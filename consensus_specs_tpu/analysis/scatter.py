@@ -1,0 +1,71 @@
+"""Rule `no-scatter`: reduction helpers must not use `.at[...].add/set`.
+
+The incident behind this rule (PR 3, CHANGES.md): the grouped RLC flush's
+per-segment G1 reduction was specified scatter-free — `g1_segment_sum`
+builds a masked segment-sum TREE (log-depth, mask + where + tree add) because
+`.at[seg].add(...)` lowers to an XLA scatter, which serializes on TPU,
+breaks the fixed-shape sharding of the mesh variant, and (for the Jacobian
+point formulas) is not even associativity-safe under duplicate indices the
+way the masked tree is.
+
+Scope: the G1/G2/Fp12 reduction modules (default `ops/bls12_jax.py`).
+Static `.at[<constant>].set(...)` forms (dynamic_update_slice with a
+constant index, e.g. limb surgery) are NOT scatters and are exempt — the
+rule fires only when the subscript is data-dependent.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, path_matches
+
+RULE_ID = "no-scatter"
+SCOPE = ("ops/bls12_jax.py",)
+_SCATTER_METHODS = {"add", "set", "mul", "max", "min", "subtract", "divide"}
+
+
+def _is_static_index(node: ast.AST) -> bool:
+    """Constant ints, constant slices, Ellipsis, and tuples thereof."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _is_static_index(node.operand)
+    if isinstance(node, ast.Slice):
+        return all(p is None or _is_static_index(p)
+                   for p in (node.lower, node.upper, node.step))
+    if isinstance(node, ast.Tuple):
+        return all(_is_static_index(e) for e in node.elts)
+    return False
+
+
+class NoScatterRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "no dynamic .at[...].add/set scatters in the sanctioned-tree reduction modules"
+
+    def __init__(self, scope: tuple[str, ...] = SCOPE):
+        self.scope = scope
+
+    def check_module(self, mod: Module) -> list[Finding]:
+        if not any(path_matches(mod.rel, p) for p in self.scope):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SCATTER_METHODS):
+                continue
+            sub = node.func.value
+            if not (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "at"):
+                continue
+            if _is_static_index(sub.slice):
+                continue
+            findings.append(Finding(
+                path=mod.rel, line=node.lineno, rule=self.id, severity="error",
+                message=f".at[...].{node.func.attr}(...) with a dynamic index "
+                        "is an XLA scatter in a reduction helper",
+                hint="use the masked segment-sum tree (g1_segment_sum) — "
+                     "scatter serializes on TPU and breaks the mesh sharding"))
+        return findings

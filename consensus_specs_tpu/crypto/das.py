@@ -27,10 +27,19 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..ops import fr_jax
-from ..ops.fr_jax import R_MODULUS as MODULUS
-from ..ops.fr_jax import root_of_unity
+from ..ops.fr_host import R_MODULUS as MODULUS
+from ..ops.fr_host import host_ntt, root_of_unity
 from . import kzg
+
+
+def _fr_jax():
+    """Device NTT kernels, imported lazily: the `use_device=False` sampling
+    and recovery path must stay usable in a jax-free process (PR-3
+    deferred-import discipline, mirroring crypto/bls.py; the poisoned-module
+    subprocess test in tests/test_deferred_crypto_path.py holds this)."""
+    from ..ops import fr_jax
+
+    return fr_jax
 
 # --- reverse-bit-order layout (das-core.md:66-77) ---------------------------
 
@@ -63,9 +72,10 @@ def data_to_coeffs(data: list[int], use_device: bool = True) -> list[int]:
     (one inverse NTT; shared by extension and commitment so each runs once)."""
     n = len(data)
     if use_device:
-        intt = fr_jax.make_ntt(n, inverse=True)
-        return fr_jax.mont_batch_to_ints(intt(np.asarray(fr_jax.ints_to_mont_batch(data))))
-    return fr_jax.host_ntt(data, inverse=True)
+        fr = _fr_jax()
+        intt = fr.make_ntt(n, inverse=True)
+        return fr.mont_batch_to_ints(intt(np.asarray(fr.ints_to_mont_batch(data))))
+    return host_ntt(data, inverse=True)
 
 
 def _extension_from_coeffs(coeffs: list[int], use_device: bool) -> list[int]:
@@ -75,10 +85,11 @@ def _extension_from_coeffs(coeffs: list[int], use_device: bool) -> list[int]:
     n = len(coeffs)
     padded = coeffs + [0] * n
     if use_device:
-        ntt2 = fr_jax.make_ntt(2 * n)
-        full = fr_jax.mont_batch_to_ints(ntt2(np.asarray(fr_jax.ints_to_mont_batch(padded))))
+        fr = _fr_jax()
+        ntt2 = fr.make_ntt(2 * n)
+        full = fr.mont_batch_to_ints(ntt2(np.asarray(fr.ints_to_mont_batch(padded))))
     else:
-        full = fr_jax.host_ntt(padded)
+        full = host_ntt(padded)
     return full[1::2]
 
 
@@ -129,9 +140,10 @@ def recover_data(samples: dict[int, int], n2: int, use_device: bool = True) -> l
 
     def ntt(vals, inverse=False):
         if use_device:
-            f = fr_jax.make_ntt(len(vals), inverse=inverse)
-            return fr_jax.mont_batch_to_ints(f(np.asarray(fr_jax.ints_to_mont_batch(vals))))
-        return fr_jax.host_ntt(vals, inverse=inverse)
+            fr = _fr_jax()
+            f = fr.make_ntt(len(vals), inverse=inverse)
+            return fr.mont_batch_to_ints(f(np.asarray(fr.ints_to_mont_batch(vals))))
+        return host_ntt(vals, inverse=inverse)
 
     z_coeffs = _zero_poly(missing, n2)
     z_coeffs_padded = z_coeffs + [0] * (n2 - len(z_coeffs))

@@ -24,7 +24,11 @@ from __future__ import annotations
 from dataclasses import dataclass
 from hashlib import sha256
 
-from ..ops.fr_jax import R_MODULUS, root_of_unity
+# fr_host (not fr_jax): the polynomial-commitment host math must stay
+# importable in jax-free processes (PR-3 deferred-import discipline, enforced
+# by tpulint's import-layering pass — crypto/kzg_shim.py and crypto/das.py
+# sit on this module's import chain).
+from ..ops.fr_host import R_MODULUS, root_of_unity
 from .bls12_381 import (
     F12_ONE,
     FP2_FIELD,

@@ -125,7 +125,7 @@ def construct_proofs_bytes(poly_coeffs: list, points_per_sample: int) -> list:
     # extended-data polynomial: degree < n, top half must be zero
     assert all(c == 0 for c in coeffs[n2 // 2:]), "not an extension polynomial"
     coeffs = coeffs[: n2 // 2]
-    from ..ops.fr_jax import root_of_unity
+    from ..ops.fr_host import root_of_unity
 
     w = root_of_unity(n2)
     setup = get_setup()

@@ -532,7 +532,7 @@ def miller_loop_batch(Qx, Qy, xp, yp):
         f = f12_mul_sparse035(f12_sqr(f), l0, l3, l5)
         return jax.lax.cond(bits[i], add_branch, lambda c: c, (f, T))
 
-    f, T = jax.lax.fori_loop(0, len(_X_BITS), body, (f, T))
+    f, T = jax.lax.fori_loop(jnp.int32(0), jnp.int32(len(_X_BITS)), body, (f, T))
     return f12_conj(f)  # x < 0
 
 
@@ -600,7 +600,7 @@ def _f12_pow_abs_x(f):
         r = f12_cyclotomic_sqr(r)
         return jax.lax.cond(bits[i], lambda r: f12_mul(r, f), lambda r: r, r)
 
-    return jax.lax.fori_loop(0, len(_X_BITS), body, f)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(len(_X_BITS)), body, f)
 
 
 def _f12_pow_x(f):
@@ -816,7 +816,7 @@ def g1_scalar_mul_batch(pt, bits):
         return g1_add(acc, gather(w))
 
     acc = gather(n_windows - 1)
-    return jax.lax.fori_loop(0, n_windows - 1, body, acc)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_windows - 1), body, acc)
 
 
 @lru_cache(maxsize=1)
@@ -1008,7 +1008,7 @@ def g2_scalar_mul_batch(pt, bits):
         return g2_add(acc, gather(w))
 
     acc = gather(n_windows - 1)
-    return jax.lax.fori_loop(0, n_windows - 1, body, acc)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_windows - 1), body, acc)
 
 
 def g2_sum_reduce(pts):

@@ -331,7 +331,7 @@ def _pow_const(a, exponent: int):
         mul = _mont_mul(acc, a)
         return jnp.where(bits[i] == 1, mul, acc)
 
-    return jax.lax.fori_loop(0, bits.shape[0], body, one)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(bits.shape[0]), body, one)
 
 
 fp_add = jax.jit(_add)

@@ -28,14 +28,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Re-exported host surface: established import site for callers.
+from .fr_host import (  # noqa: F401
+    PRIMITIVE_ROOT,
+    R_MODULUS,
+    TWO_ADICITY,
+    domain,
+    host_ntt,
+    root_of_unity,
+)
 from .limb_mont import MontgomeryField
-
-# Curve order of BLS12-381 (the "inner" / scalar modulus, reference
-# specs/sharding/beacon-chain.md:107) and its primitive root 7 (:104).
-R_MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
-PRIMITIVE_ROOT = 7
-TWO_ADICITY = 32
-assert (R_MODULUS - 1) % (1 << TWO_ADICITY) == 0
 
 NLIMBS = 16
 FIELD = MontgomeryField(R_MODULUS, NLIMBS)
@@ -57,26 +59,7 @@ fr_pow_const = FIELD.pow_const
 fr_inv = FIELD.inv
 
 
-# --- roots of unity / domains -----------------------------------------------
-
-
-def root_of_unity(order: int) -> int:
-    """Primitive `order`-th root of unity in Fr (order a power of two ≤ 2^32).
-
-    Matches the reference's ROOT_OF_UNITY derivation
-    (specs/sharding/beacon-chain.md:174): 7^((r-1)/order) mod r."""
-    assert order & (order - 1) == 0 and order <= (1 << TWO_ADICITY)
-    return pow(PRIMITIVE_ROOT, (R_MODULUS - 1) // order, R_MODULUS)
-
-
-def domain(n: int) -> list[int]:
-    """[w^0, w^1, ..., w^(n-1)] for the n-th root w (host ints)."""
-    w = root_of_unity(n)
-    out, acc = [], 1
-    for _ in range(n):
-        out.append(acc)
-        acc = acc * w % R_MODULUS
-    return out
+# --- roots of unity / domains (host math in ops/fr_host.py) ------------------
 
 
 def _twiddle_tables(n: int, inverse: bool) -> list[np.ndarray]:
@@ -97,8 +80,8 @@ def _twiddle_tables(n: int, inverse: bool) -> list[np.ndarray]:
 
 def _bit_reverse_perm(n: int) -> np.ndarray:
     bits = n.bit_length() - 1
-    idx = np.arange(n)
-    rev = np.zeros(n, dtype=np.int64)
+    idx = np.arange(n)  # tpulint: disable=jit-purity -- trace-time table on the static NTT size
+    rev = np.zeros(n, dtype=np.int64)  # tpulint: disable=jit-purity -- trace-time table on the static NTT size
     for b in range(bits):
         rev |= ((idx >> b) & 1) << (bits - 1 - b)
     return rev
@@ -140,21 +123,4 @@ def make_ntt(n: int, inverse: bool = False):
     return ntt
 
 
-# --- host oracle -------------------------------------------------------------
-
-
-def host_ntt(values: list[int], inverse: bool = False) -> list[int]:
-    """O(n^2) reference DFT over Fr (host ints) for differential tests."""
-    n = len(values)
-    w = root_of_unity(n)
-    if inverse:
-        w = pow(w, R_MODULUS - 2, R_MODULUS)
-    out = []
-    for i in range(n):
-        acc = 0
-        for j, v in enumerate(values):
-            acc = (acc + v * pow(w, i * j, R_MODULUS)) % R_MODULUS
-        if inverse:
-            acc = acc * pow(n, R_MODULUS - 2, R_MODULUS) % R_MODULUS
-        out.append(acc)
-    return out
+# --- host oracle: fr_host.host_ntt (re-exported above) -----------------------

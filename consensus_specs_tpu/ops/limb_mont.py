@@ -111,7 +111,7 @@ class MontgomeryField:
             nxt = jax.lax.dynamic_index_in_dim(t, i + 1, axis=-1, keepdims=False)
             return jax.lax.dynamic_update_index_in_dim(t, nxt + (v >> lb), i + 1, axis=-1)
 
-        return jax.lax.fori_loop(0, n - 1, body, t)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n - 1), body, t)
 
     def sub_limbs(self, x, y):
         """x - y over canonical u64 limb vectors, assuming x >= y."""
@@ -131,7 +131,7 @@ class MontgomeryField:
             borrow = jnp.uint64(1) - (d >> lb)
             return borrow, out
 
-        _, res = jax.lax.fori_loop(0, self.nlimbs, body, (borrow0, out))
+        _, res = jax.lax.fori_loop(jnp.int32(0), jnp.int32(self.nlimbs), body, (borrow0, out))
         return res
 
     def geq_vec(self, a64, vec):
@@ -179,7 +179,7 @@ class MontgomeryField:
             window = jax.lax.dynamic_slice_in_dim(t, i, self.nlimbs, axis=-1)
             return jax.lax.dynamic_update_slice_in_dim(t, window + ai * b64, i, axis=-1)
 
-        return jax.lax.fori_loop(0, self.nlimbs, body, t)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(self.nlimbs), body, t)
 
     def _mont_mul(self, a, b):
         """Montgomery product (a·b·R^-1 mod modulus); SOS with deferred carries."""
@@ -200,7 +200,7 @@ class MontgomeryField:
             window = window.at[..., 1].add(carry)
             return jax.lax.dynamic_update_slice_in_dim(t, window, i, axis=-1)
 
-        t = jax.lax.fori_loop(0, self.nlimbs, body, t)
+        t = jax.lax.fori_loop(jnp.int32(0), jnp.int32(self.nlimbs), body, t)
         hi = self.carry_pass(t[..., self.nlimbs :])
         return self.cond_sub_mod(hi[..., : self.nlimbs]).astype(jnp.uint32)
 
@@ -214,7 +214,7 @@ class MontgomeryField:
             mul = self._mont_mul(acc, a)
             return jnp.where(bits[i] == 1, mul, acc)
 
-        return jax.lax.fori_loop(0, bits.shape[0], body, one)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(bits.shape[0]), body, one)
 
     def inv(self, a):
         """Batched Fermat inversion a^(mod-2); zero maps to zero."""

@@ -120,7 +120,7 @@ def shuffled_index_map(n: int, seed_words: jax.Array, rounds: int) -> jax.Array:
         bit = (byte >> (position & 7)) & jnp.uint32(1)
         return jnp.where(bit == 1, flip, idx)
 
-    return jax.lax.fori_loop(0, rounds, body, idx)
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(rounds), body, idx)
 
 
 def compute_shuffled_indices(n: int, seed: bytes, rounds: int) -> np.ndarray:

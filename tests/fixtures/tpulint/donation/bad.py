@@ -1,0 +1,21 @@
+"""donation-alias positive fixture: reading a buffer already donated to jit
+(the PR-1 incident shape: the memoized diff-base columns read after the
+donating epoch dispatch)."""
+import jax
+import numpy as np
+
+
+def _step(cols, updates):
+    return cols + updates
+
+
+def epoch_loop(cols, updates):
+    step = jax.jit(_step, donate_argnums=(0,))
+    new_cols = step(cols, updates)
+    checksum = np.sum(cols)  # tpulint-expect: donation-alias
+    return new_cols, checksum
+
+
+def direct_call(cols, updates):
+    out = jax.jit(_step, donate_argnums=(0,))(cols, updates)
+    return out, cols.shape  # tpulint-expect: donation-alias

@@ -1,0 +1,27 @@
+"""donation-alias negative fixture: rebinding from the call's result (the
+resident-engine pattern) and copies taken before donation are both clean."""
+import jax
+import numpy as np
+
+
+def _step(cols, updates):
+    return cols + updates
+
+
+def epoch_loop(cols, updates):
+    step = jax.jit(_step, donate_argnums=(0,))
+    cols = step(cols, updates)
+    return cols  # rebound from the call's result: owning, safe
+
+
+def epoch_loop_with_copy(cols, updates):
+    step = jax.jit(_step, donate_argnums=(0,))
+    snapshot = np.asarray(cols)  # owning copy taken BEFORE donation
+    cols = step(cols, updates)
+    return cols, np.sum(snapshot)
+
+
+def undonated(cols, updates):
+    step = jax.jit(_step)
+    out = step(cols, updates)
+    return out, np.sum(cols)  # no donation: reads stay legal

@@ -1,0 +1,22 @@
+"""jit-purity positive fixture: host effects reachable inside jit tracing."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def leaky_kernel(x):
+    print("tracing", x)  # tpulint-expect: jit-purity
+    y = np.log(x)  # tpulint-expect: jit-purity
+    return jnp.sum(y)
+
+
+def _helper(x):
+    return x.item()  # tpulint-expect: jit-purity
+
+
+def wrapped(x):
+    return _helper(x) + 1
+
+
+fast_wrapped = jax.jit(wrapped)

@@ -1,0 +1,18 @@
+"""jit-purity negative fixture: host effects only outside jit reach, np dtype
+constructors (the pinning pattern) exempt inside."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def clean_kernel(x):
+    return jnp.sum(x * jnp.int32(2)) + jnp.int32(np.int32(1))
+
+
+def host_report(x):
+    print("result:", np.asarray(x))
+    return np.asarray(x).tolist()
+
+
+TABLE = np.arange(16)

@@ -1,0 +1,8 @@
+"""Seeded historical-bug replay (pre-PR-3 crypto/bls.py): a module-level
+bls_jax import in the py-branch shim — a pure-Python-oracle process (no jax
+importable) could not even import the module."""
+from . import bls_jax  # noqa  tpulint-expect: import-layering
+
+
+def backend():
+    return "py"

@@ -1,0 +1,2 @@
+"""Fixture device backend: importing this module requires jax."""
+import jax  # noqa  (the whole point: this module is jax-only)

@@ -1,0 +1,7 @@
+"""Transitive py-branch leak: das itself never says `import jax`, but its
+module-level import chain reaches a jax-importing kernel module."""
+from ..ops import fr_jax  # tpulint-expect: import-layering
+
+
+def extend(data):
+    return fr_jax.ntt(data)

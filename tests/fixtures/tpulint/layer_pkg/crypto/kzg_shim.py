@@ -1,0 +1,14 @@
+"""Negative case: the py-branch shim with properly deferred device imports
+(the PR-3 discipline) stays clean."""
+
+
+def _fr_jax():
+    from ..ops import fr_jax  # deferred: only the device path pays
+
+    return fr_jax
+
+
+def commit(data, use_device=False):
+    if use_device:
+        return _fr_jax().ntt(data)
+    return sum(data)

@@ -1,0 +1,6 @@
+"""Test-only leak: production orchestration importing testlib helpers."""
+from ..testlib import helpers  # tpulint-expect: import-layering
+
+
+def orchestrate(x):
+    return helpers.build(x)

@@ -1,0 +1,5 @@
+"""Negative case: the evm py-branch, pure Python end to end, stays clean."""
+
+
+def encode(x):
+    return bytes([x % 256])

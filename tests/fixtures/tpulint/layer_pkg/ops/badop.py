@@ -1,0 +1,7 @@
+"""Layer-order violation: a leaf kernel module importing the orchestration
+layer above it."""
+from ..engine import loop  # tpulint-expect: import-layering
+
+
+def kernel(x):
+    return loop.run(x)

@@ -1,0 +1,7 @@
+"""Fixture kernel module: module-level jax import (legitimate here — ops/ IS
+the device layer)."""
+import jax
+
+
+def ntt(values):
+    return jax.numpy.asarray(values)

@@ -1,0 +1,6 @@
+"""Negative case: spec_tests/ is a sanctioned testlib consumer."""
+from ..testlib import helpers
+
+
+def scenario(x):
+    return helpers.build(x)

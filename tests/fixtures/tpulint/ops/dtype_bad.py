@@ -1,0 +1,20 @@
+"""Seeded historical-bug replay (PR 1, CHANGES.md): fori_loop bounds left as
+bare Python ints traced s64 under x64 mode against an s32 carry — the GSPMD
+verifier failure on sharded programs. Plus the ambient-dtype constructor."""
+import jax
+import jax.numpy as jnp
+
+
+def sha_rounds(state):
+    def round_fn(i, st):
+        return st + jnp.uint32(i)
+
+    return jax.lax.fori_loop(0, 64, round_fn, state)  # tpulint-expect: dtype-pin
+
+
+def widen(n):
+    return jnp.zeros(n)  # tpulint-expect: dtype-pin
+
+
+def window(n):
+    return jnp.arange(n)  # tpulint-expect: dtype-pin

@@ -1,0 +1,26 @@
+"""dtype-pin negative fixture: the sanctioned ops/sha256_jax.py spellings."""
+import jax
+import jax.numpy as jnp
+
+
+def sha_rounds(state):
+    def round_fn(i, st):
+        return st + jnp.uint32(i)
+
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(64), round_fn, state)
+
+
+def widen(n):
+    return jnp.zeros(n, dtype=jnp.uint32)
+
+
+def widen_positional(n):
+    return jnp.zeros(n, jnp.uint32)
+
+
+def window(n):
+    return jnp.arange(n, dtype=jnp.int32)
+
+
+def inherit(x):
+    return jnp.zeros_like(x)

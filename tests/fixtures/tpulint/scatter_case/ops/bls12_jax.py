@@ -1,0 +1,18 @@
+"""no-scatter fixture (file named like the real reduction module so the
+path-scoped rule applies): a dynamic-index scatter is flagged, the static
+limb-surgery form is exempt."""
+import jax.numpy as jnp
+
+
+def segment_sum_scatter(acc, seg_ids, vals):
+    return acc.at[seg_ids].add(vals)  # tpulint-expect: no-scatter
+
+
+def segment_set_scatter(acc, idx, vals):
+    return acc.at[idx].set(vals)  # tpulint-expect: no-scatter
+
+
+def limb_surgery_ok(window, carry):
+    window = window.at[..., 0].set(jnp.uint64(0))
+    window = window.at[..., 1].add(carry)
+    return window.at[2:4].set(jnp.uint64(1))

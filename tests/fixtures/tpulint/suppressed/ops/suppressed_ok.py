@@ -1,0 +1,12 @@
+"""Suppression fixture: the violation is real but carries a one-line
+justification, so the run stays clean (self-test fails on any unexpected
+finding — including here, if suppression parsing regresses)."""
+import jax.numpy as jnp
+
+
+def trace_time_table(n):
+    return jnp.zeros(n)  # tpulint: disable=dtype-pin -- trace-time table on a static size; ambient dtype fine
+
+
+def blanket(n):
+    return jnp.arange(n)  # tpulint: disable -- fixture: blanket suppression form

@@ -1,0 +1,71 @@
+"""PR-3 deferred-import discipline for the KZG/DAS crypto modules.
+
+crypto/kzg.py, crypto/kzg_shim.py, and crypto/das.py are py-branch modules:
+a pure-Python oracle process (jax unimportable) must be able to run the full
+`use_device=False` surface — setup, commit, degree-bound proofs, DAS
+extension and recovery — with the device NTT module (ops/fr_jax) never
+imported. Mirrors tests/test_bls.py::test_py_backend_survives_unimportable_
+bls_jax: the modules are poisoned via a sys.meta_path blocker in a
+SUBPROCESS, so any module-level (or eagerly reached) jax import fails loudly.
+
+tpulint's import-layering rule enforces the same invariant statically; this
+test proves it dynamically.
+"""
+import subprocess
+import sys
+
+
+def test_kzg_das_survive_unimportable_jax():
+    code = """
+import sys
+
+BLOCKED_EXACT = {
+    "jax", "jaxlib",
+    "consensus_specs_tpu.ops.fr_jax",
+    "consensus_specs_tpu.ops.limb_mont",
+}
+
+
+class _Block:
+    def find_spec(self, name, path=None, target=None):
+        if name in BLOCKED_EXACT or name.split(".")[0] in ("jax", "jaxlib"):
+            raise ImportError(f"poisoned for test: {name}")
+        return None
+
+
+sys.meta_path.insert(0, _Block())
+
+from consensus_specs_tpu.crypto import das, kzg, kzg_shim
+
+# Host NTT extension straight off the shared fr_host helpers.
+data = [(i * 31 + 7) % kzg.MODULUS for i in range(8)]
+assert das.das_fft_extension(data, use_device=False)
+
+# Full sampling pipeline: commit, degree bound, per-sample proofs, verify.
+setup = kzg.insecure_test_setup(16)
+kzg_shim.use_setup(setup)
+commitment_bytes = kzg_shim.commit_to_data(data)
+degree_proof = kzg_shim.prove_degree_bound_bytes(data, len(data))
+assert kzg_shim.verify_degree_bound(commitment_bytes, degree_proof, len(data))
+
+extended = das.extend_data(data, use_device=False)
+commitment, samples = das.sample_data(
+    setup, data, points_per_sample=4, use_device=False)
+for sample in samples:
+    assert das.verify_sample(setup, commitment, sample, 2 * len(data),
+                             points_per_sample=4)
+
+# Recovery from half the extended points (erasure path, host branch).
+n2 = 2 * len(data)
+known = {i: extended[i] for i in range(0, n2, 2)}
+recovered = das.recover_data(known, n2, use_device=False)
+assert recovered == extended
+
+for mod in BLOCKED_EXACT:
+    assert mod not in sys.modules, f"{mod} leaked into the py-branch process"
+print("JAX-FREE-CRYPTO-OK")
+"""
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    assert "JAX-FREE-CRYPTO-OK" in res.stdout

@@ -1,0 +1,175 @@
+"""tpulint CLI: AST-based invariant checker for the JAX hot path.
+
+Usage:
+    python tools/tpulint.py [paths...]            # default: consensus_specs_tpu
+        [--baseline tpulint_baseline.json]        # auto-loaded when present
+        [--no-baseline]                           # report every finding as new
+        [--write-baseline]                        # regenerate (shrink-only)
+        [--allow-growth]                          # explicit override for growth
+        [--rules id1,id2]                         # subset of passes
+        [--list-rules] [--json] [--self-test]
+
+Exit codes: 0 clean (no findings beyond the baseline), 1 new findings (or
+any finding with --no-baseline / on non-baselined paths), 2 usage errors.
+
+--self-test replays the analyzer over its own fixture corpus
+(tests/fixtures/tpulint): every `# tpulint-expect: <rule>` annotation must
+be matched by a finding of that rule on that line and no fixture may produce
+unexpected findings — the analyzer proves it still catches the seeded
+historical bugs (the unpinned fori_loop bound; the module-level bls_jax
+import in a py-branch module) before it is trusted to gate CI.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.analysis import ALL_RULES, analyze_paths  # noqa: E402
+from consensus_specs_tpu.analysis.baseline import (  # noqa: E402
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_PATHS = [str(REPO / "consensus_specs_tpu")]
+DEFAULT_BASELINE = REPO / "tpulint_baseline.json"
+FIXTURES = REPO / "tests" / "fixtures" / "tpulint"
+
+
+def _canon(finding):
+    """Repo-relative finding paths regardless of invocation cwd, so baseline
+    diffs (and --write-baseline output) are stable whether tpulint runs from
+    the repo root (make lint), CI, or anywhere else."""
+    try:
+        rel = Path(finding.path).resolve().relative_to(REPO)
+    except ValueError:
+        return finding
+    return dataclasses.replace(finding, path=rel.as_posix())
+
+
+def _self_test() -> int:
+    """Run every fixture root and compare against its inline expectations."""
+    roots = sorted(p for p in FIXTURES.iterdir()
+                   if p.name != "__pycache__" and (p.is_dir() or p.suffix == ".py"))
+    if not roots:
+        print(f"tpulint --self-test: no fixtures under {FIXTURES}", file=sys.stderr)
+        return 2
+    result = analyze_paths(roots)
+    got = {(f.path, f.line, f.rule) for f in result.findings}
+    expected = set()
+    for root in roots:
+        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts:
+                continue
+            rel_root = root.as_posix()
+            rel = rel_root if root.is_file() else \
+                f"{rel_root}/{f.relative_to(root).as_posix()}"
+            for i, line in enumerate(f.read_text().splitlines(), start=1):
+                if "tpulint-expect:" not in line:
+                    continue
+                for rule in line.split("tpulint-expect:")[1].split("--")[0].split(","):
+                    expected.add((rel, i, rule.strip()))
+    missed = expected - got
+    unexpected = got - expected
+    for path, line, rule in sorted(missed):
+        print(f"SELF-TEST MISS: expected {rule} at {path}:{line}")
+    for path, line, rule in sorted(unexpected):
+        print(f"SELF-TEST UNEXPECTED: {rule} at {path}:{line}")
+    ok = not missed and not unexpected
+    print(f"tpulint --self-test: {len(expected)} expectations over "
+          f"{result.file_count} fixture files: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpulint", add_help=True)
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--allow-growth", action="store_true")
+    ap.add_argument("--rules", default=None)
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--self-test", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:16s} [{rule.severity}] {rule.doc}")
+        return 0
+    if args.self_test:
+        return _self_test()
+
+    rules = ALL_RULES
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",")}
+        unknown = wanted - {r.id for r in ALL_RULES}
+        if unknown:
+            print(f"tpulint: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = tuple(r for r in ALL_RULES if r.id in wanted)
+
+    paths = args.paths or DEFAULT_PATHS
+    missing = [p for p in paths if not Path(p).exists()]
+    if missing:
+        print(f"tpulint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    result = analyze_paths(paths, rules)
+    result.findings = [_canon(f) for f in result.findings]
+
+    baseline_path = Path(args.baseline) if args.baseline else Path(DEFAULT_BASELINE)
+    baseline = None
+    if not args.no_baseline and baseline_path.exists():
+        baseline = load_baseline(baseline_path)
+
+    if args.write_baseline:
+        old_budget = baseline["budget"] if baseline else len(result.findings)
+        count = len(result.findings)
+        if count > old_budget and not args.allow_growth:
+            print(f"tpulint: refusing to grow the baseline "
+                  f"({count} findings > budget {old_budget}); fix or suppress "
+                  "the new findings, or pass --allow-growth with a review",
+                  file=sys.stderr)
+            return 1
+        budget = min(old_budget, count) if not args.allow_growth else count
+        write_baseline(result.findings, baseline_path, budget)
+        print(f"tpulint: wrote {baseline_path} ({count} findings, "
+              f"budget {budget})")
+        return 0
+
+    new, fixed = (diff_against_baseline(result.findings, baseline)
+                  if baseline else (result.findings, 0))
+
+    if args.as_json:
+        print(json.dumps({
+            "files": result.file_count,
+            "findings": [f.as_json() for f in result.findings],
+            "new": [f.as_json() for f in new],
+            "suppressed": result.suppressed,
+            "fixed_vs_baseline": fixed,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        label = "new findings vs baseline" if baseline else "findings"
+        print(f"tpulint: {result.file_count} files, "
+              f"{len(result.findings)} findings ({len(new)} {label}, "
+              f"{result.suppressed} suppressed"
+              + (f", {fixed} fixed vs baseline" if baseline else "") + ")")
+        if baseline and fixed:
+            print("tpulint: baseline entries were fixed — ratchet down with "
+                  f"`python tools/tpulint.py --write-baseline` ({baseline_path})")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
