@@ -10,9 +10,10 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding
 
-.PHONY: test testall citest testfast lint pyspec generate_tests clean_vectors \
-        detect_generator_incomplete bench bench_quick bench-probe graft_check \
-        native replay random_codegen coverage deposit_contract_json
+.PHONY: test testall citest testfast chaos lint pyspec generate_tests \
+        clean_vectors detect_generator_incomplete bench bench_quick \
+        bench-probe graft_check native replay random_codegen coverage \
+        deposit_contract_json
 
 # Default developer loop: full suite (minimal preset, BLS stubbed where the
 # suite chooses; JAX pinned to the virtual 8-device CPU mesh by tests/conftest.py).
@@ -32,6 +33,16 @@ citest:
 # Quick sanity loop: skip every device-pairing test.
 testfast:
 	$(PYTHON) -m pytest tests/ -x -q -k "not pairing"
+
+# Fault-tolerance lane: the robustness unit suite plus the seeded chaos
+# convergence runs (faults at every device-boundary seam must leave the
+# state root bit-identical to the fault-free oracle — see README "Fault
+# tolerance"). Deterministic schedules only; the long randomized soak is
+# marked `slow` and runs in testall/citest. Hard wall-clock bound so a
+# retry/backoff regression hangs the lane loudly instead of silently.
+chaos:
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_chaos_epoch.py tests/test_robustness.py -q -m "not slow"
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

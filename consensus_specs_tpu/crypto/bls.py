@@ -30,6 +30,8 @@ from . import bls_sig as _py
 # (False until crypto/isogeny.py lands: signatures are internally consistent
 # but not RFC-9380-interoperable; see crypto/hash_to_curve.py docstring).
 from .hash_to_curve import MAP_TO_CURVE_RFC_COMPLIANT  # noqa: F401
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
 
 bls_active = True
 _backend = "py"
@@ -105,18 +107,28 @@ class deferred_verification:
             del _deferral.queue[self._entry_len:]
         if _deferral.depth > 0:
             return False  # inner context: the outermost one flushes
-        queue, _deferral.queue = _deferral.queue, None
-        if exc_type is not None:
-            return False  # propagate; skip verification of a failed body
-        if queue:
-            flush_count += 1
-            results = _flush_deferred(queue)
-            if not all(results):
-                bad = [i for i, ok in enumerate(results) if not ok]
-                raise BLSVerificationError(
-                    f"deferred batch verification failed for checks {bad}"
-                )
-        return False
+        queue = _deferral.queue
+        try:
+            if exc_type is not None:
+                return False  # propagate; skip verification of a failed body
+            if queue:
+                flush_count += 1
+                results = _flush_retrying(queue)
+                if not all(results):
+                    bad = [i for i, ok in enumerate(results) if not ok]
+                    raise BLSVerificationError(
+                        f"deferred batch verification failed for checks {bad}"
+                    )
+            return False
+        finally:
+            # Structural reset: whatever escaped above — BLSVerificationError,
+            # a device error the retries couldn't absorb — the NEXT
+            # deferred_verification() on this thread must start from a clean
+            # slate. Leaving the failed batch's queue attached would silently
+            # append an unrelated block's checks onto checks the caller
+            # already saw fail (queue poisoning).
+            _deferral.queue = None
+            _deferral.depth = 0
 
 
 class inline_verification:
@@ -137,8 +149,21 @@ class inline_verification:
         return False
 
 
+# Flush dispatch is side-effect-free on the queue (it only reads the
+# ("kind", args) tuples), so re-dispatching the same queue after a transient
+# device error is safe — there is no partially-consumed state to unwind.
+FLUSH_RETRY_POLICY = _retry.RetryPolicy(
+    max_attempts=3, base_delay=0.02, max_delay=0.2)
+
+
+def _flush_retrying(queue):
+    return _retry.call_with_retry(
+        lambda: _flush_deferred(queue), FLUSH_RETRY_POLICY)
+
+
 def _flush_deferred(queue):
     """queue: list of ("kind", args) tuples -> list[bool]."""
+    _faults.fire("bls.flush")
     if _backend == "jax":
         # Imported only on the jax path (ADVICE r5): a pure-Python-oracle
         # process (no jax installed) must be able to defer, flush, and
@@ -174,7 +199,7 @@ def _check(kind, args, py_fn):
         return True
     inline_check_count += 1
     if _backend == "jax":
-        return bool(_flush_deferred([(kind, args)])[0])
+        return bool(_flush_retrying([(kind, args)])[0])
     return py_fn(*args)
 
 

@@ -16,6 +16,14 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ..robustness import faults as rfaults
+from ..robustness.breaker import CircuitBreaker
+from ..robustness.retry import (
+    DEVICE_POLICY,
+    PROBE_POLICY,
+    call_with_retry,
+    is_device_failure,
+)
 from .epoch import epoch_fn_for, historical_batch_root
 from .state import DIRTY_TRACKED, EpochConfig, EpochState
 from .sync_committee import next_sync_committee_indices
@@ -122,11 +130,172 @@ def write_back_full_bytes(dev: EpochState) -> int:
     return sum(int(getattr(dev, name).nbytes) for name in DIRTY_TRACKED)
 
 
+def _check_staged(name: str, post: np.ndarray, dev_arr) -> None:
+    """Structural validation of one staged D2H copy against the device
+    array it came from: dtype, shape, finiteness. This is what catches a
+    torn transfer (the write-back corruption seam models one); value-level
+    corruption that preserves structure would need checksummed transfers
+    — out of scope, documented in the README fault-tolerance section."""
+    expected = np.dtype(dev_arr.dtype)
+    shape = tuple(dev_arr.shape)
+    post = np.asarray(post)
+    if post.dtype != expected or post.shape != shape:
+        raise rfaults.TornWriteBackError(
+            f"write-back staging of {name}: got {post.dtype}{post.shape}, "
+            f"device holds {expected}{shape}")
+    if np.issubdtype(post.dtype, np.floating) and not np.isfinite(post).all():
+        raise rfaults.TornWriteBackError(
+            f"write-back staging of {name}: non-finite values in transfer")
+
+
+def _stage_write_back(spec, state, dev: EpochState, pre_cols: dict,
+                      pre_mixes: np.ndarray | None = None,
+                      dirty: dict | None = None,
+                      mix_rows=None) -> dict:
+    """Phase 1 of the two-phase write-back: every D2H transfer, diff, and
+    validation — NO host-state mutation. A failure anywhere in here
+    (including the injected kill and torn-transfer corruptions) leaves
+    `state`, `pre_cols` and `pre_mixes` untouched, so staging can be
+    retried from the intact device arrays and a crash can never tear the
+    registry. Returns the staged shadow buffer `_commit_write_back` swaps
+    in."""
+    def is_dirty(name: str) -> bool:
+        return dirty is None or bool(dirty.get(name, True))
+
+    staged: dict = {"registry": [], "bulk": [], "clean": [], "moved": 0,
+                    "full": write_back_full_bytes(dev), "mix": None}
+    # Registry fields: diff against the pre-epoch columns so the commit only
+    # touches the validators a sub-transition actually mutated (activation
+    # churn, hysteresis, ejections — a small fraction of the registry).
+    field_types = {
+        "effective_balance": spec.Gwei,
+        "activation_eligibility_epoch": spec.Epoch,
+        "activation_epoch": spec.Epoch,
+        "exit_epoch": spec.Epoch,
+        "withdrawable_epoch": spec.Epoch,
+        "slashed": spec.boolean,
+    }
+    for name, typ in field_types.items():
+        if not is_dirty(name):
+            staged["clean"].append(name)
+            continue
+        rfaults.fire("bridge.write_back")
+        dev_arr = getattr(dev, name)
+        # Owning copy, NOT np.asarray: this array outlives `dev` as the
+        # memoized diff base (pre_cols), so it must not alias device memory.
+        post = rfaults.corrupt_array("bridge.write_back.torn", np.array(dev_arr))
+        _check_staged(name, post, dev_arr)
+        staged["moved"] += post.nbytes
+        changed = np.nonzero(post != pre_cols[name])[0]
+        staged["registry"].append(
+            (name, typ, changed.tolist(), post[changed].tolist(), post))
+    # Whole-registry vectors: bulk one-pass reconstruction at commit.
+    bulk_fields = {
+        "balances": "balances",
+        "inactivity_scores": "inactivity_scores",
+        "prev_participation": "previous_epoch_participation",
+        "curr_participation": "current_epoch_participation",
+        "slashings": "slashings",
+    }
+    for dev_name, state_name in bulk_fields.items():
+        if not is_dirty(dev_name):
+            staged["clean"].append(dev_name)
+            continue
+        rfaults.fire("bridge.write_back")
+        dev_arr = getattr(dev, dev_name)
+        # Owning copy: from_numpy ADOPTS this array as the SSZ list's
+        # columnar backing, which outlives `dev` (and must be writable).
+        post = rfaults.corrupt_array("bridge.write_back.torn", np.array(dev_arr))
+        _check_staged(dev_name, post, dev_arr)
+        staged["moved"] += post.nbytes
+        staged["bulk"].append((state_name, post))
+    if not is_dirty("randao_mixes"):
+        staged["clean"].append("randao_mixes")
+    elif mix_rows is not None:
+        rows = sorted({int(r) for r in mix_rows})
+        if rows:
+            rfaults.fire("bridge.write_back")
+            sel = dev.randao_mixes[jnp.asarray(rows)]
+            gathered = rfaults.corrupt_array(
+                "bridge.write_back.torn", np.array(sel))
+            _check_staged("randao_mixes[rows]", gathered, sel)
+            staged["moved"] += gathered.nbytes
+            staged["mix"] = ("rows", rows, gathered)
+    else:
+        rfaults.fire("bridge.write_back")
+        mixes = rfaults.corrupt_array(
+            "bridge.write_back.torn", np.array(dev.randao_mixes))
+        _check_staged("randao_mixes", mixes, dev.randao_mixes)
+        staged["moved"] += mixes.nbytes
+        if pre_mixes is not None:
+            # epoch processing touches at most one mix slot per epoch; diff
+            # and write only the changed rows (65536 Bytes32 writes -> ~1)
+            changed_rows = np.nonzero((mixes != pre_mixes).any(axis=1))[0].tolist()
+        else:
+            changed_rows = list(range(mixes.shape[0]))
+        staged["mix"] = ("full", mixes, changed_rows)
+    staged["justification_bits"] = np.array(dev.justification_bits)
+    staged["checkpoints"] = (
+        (int(dev.prev_justified_epoch), _words_to_root(dev.prev_justified_root)),
+        (int(dev.curr_justified_epoch), _words_to_root(dev.curr_justified_root)),
+        (int(dev.finalized_epoch), _words_to_root(dev.finalized_root)),
+    )
+    return staged
+
+
+def _commit_write_back(spec, state, staged: dict, pre_cols: dict,
+                       pre_mixes: np.ndarray | None = None) -> dict:
+    """Phase 2: swap the validated shadow buffers into the SSZ object tree
+    and the diff bases. Host memory only — nothing in here touches the
+    device or performs I/O that can fail transiently."""
+    vals = state.validators
+    for name, typ, idxs, values, post in staged["registry"]:
+        for i, value in zip(idxs, values):
+            setattr(vals[i], name, typ(value))
+        pre_cols[name] = post  # keep the memoized columns post-epoch coherent
+    for state_name, post in staged["bulk"]:
+        cur = getattr(state, state_name)
+        setattr(state, state_name, type(cur).from_numpy(post))
+    if staged["mix"] is not None:
+        mode = staged["mix"][0]
+        if mode == "rows":
+            _, rows, gathered = staged["mix"]
+            for i, words in zip(rows, gathered):
+                state.randao_mixes[i] = spec.Bytes32(_words_to_root(words))
+                if pre_mixes is not None:
+                    pre_mixes[i] = words
+        else:
+            _, mixes, changed_rows = staged["mix"]
+            if pre_mixes is not None:
+                pre_mixes[:] = mixes
+            for i in changed_rows:
+                state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
+    for i, b in enumerate(staged["justification_bits"]):
+        state.justification_bits[i] = bool(b)
+    (pj, pjr), (cj, cjr), (fi, fir) = staged["checkpoints"]
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(pj), root=spec.Root(pjr))
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(cj), root=spec.Root(cjr))
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(fi), root=spec.Root(fir))
+    # Re-key the memoized columns to the post-epoch registry root (the root
+    # is incremental: only the mutated validators' paths rehash here).
+    vals.__dict__["_engine_cols"] = (vals.hash_tree_root(), pre_cols)
+    return {"moved_bytes": staged["moved"], "full_bytes": staged["full"],
+            "clean_cols": staged["clean"]}
+
+
 def _write_back(spec, state, dev: EpochState, pre_cols: dict,
                 pre_mixes: np.ndarray | None = None,
                 dirty: dict | None = None,
-                mix_rows=None) -> dict:
-    """Write device columns back into the spec BeaconState.
+                mix_rows=None, retry_policy=None) -> dict:
+    """Write device columns back into the spec BeaconState — TWO-PHASE:
+    stage (all D2H transfers + diffs + structural validation into a shadow
+    buffer, retried under `retry_policy` on transient/torn failures because
+    it mutates nothing) then commit (host-memory-only swap). A crash during
+    staging leaves the registry exactly as it was; the commit phase has no
+    failure modes beyond host-code bugs.
 
     `dirty`: optional {column name -> bool} over DIRTY_TRACKED (from
     EpochAux.dirty_cols). Clean columns are skipped entirely — no D2H
@@ -143,96 +312,11 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict,
     "clean_cols"} where full_bytes is what a dirty-oblivious materialize
     would have moved for the same columns.
     """
-    def is_dirty(name: str) -> bool:
-        return dirty is None or bool(dirty.get(name, True))
-
-    moved = 0
-    full = write_back_full_bytes(dev)
-    clean: list[str] = []
-    # Registry fields: diff against the pre-epoch columns and touch only the
-    # validators a sub-transition actually mutated (activation churn,
-    # hysteresis, ejections — typically a small fraction of the registry).
-    vals = state.validators
-    field_types = {
-        "effective_balance": spec.Gwei,
-        "activation_eligibility_epoch": spec.Epoch,
-        "activation_epoch": spec.Epoch,
-        "exit_epoch": spec.Epoch,
-        "withdrawable_epoch": spec.Epoch,
-        "slashed": spec.boolean,
-    }
-    for name, typ in field_types.items():
-        if not is_dirty(name):
-            clean.append(name)
-            continue
-        # Owning copy, NOT np.asarray: this array outlives `dev` as the
-        # memoized diff base (pre_cols), so it must not alias device memory.
-        post = np.array(getattr(dev, name))
-        moved += post.nbytes
-        changed = np.nonzero(post != pre_cols[name])[0]
-        values = post[changed].tolist()
-        for i, value in zip(changed.tolist(), values):
-            setattr(vals[i], name, typ(value))
-        pre_cols[name] = post  # keep the memoized columns post-epoch coherent
-    # Whole-registry vectors: bulk one-pass reconstruction.
-    bulk_fields = {
-        "balances": "balances",
-        "inactivity_scores": "inactivity_scores",
-        "prev_participation": "previous_epoch_participation",
-        "curr_participation": "current_epoch_participation",
-        "slashings": "slashings",
-    }
-    for dev_name, state_name in bulk_fields.items():
-        if not is_dirty(dev_name):
-            clean.append(dev_name)
-            continue
-        # Owning copy: from_numpy ADOPTS this array as the SSZ list's
-        # columnar backing, which outlives `dev` (and must be writable).
-        post = np.array(getattr(dev, dev_name))
-        moved += post.nbytes
-        cur = getattr(state, state_name)
-        setattr(state, state_name, type(cur).from_numpy(post))
-    if not is_dirty("randao_mixes"):
-        clean.append("randao_mixes")
-    elif mix_rows is not None:
-        rows = sorted({int(r) for r in mix_rows})
-        if rows:
-            gathered = np.asarray(dev.randao_mixes[jnp.asarray(rows)])
-            moved += gathered.nbytes
-            for i, words in zip(rows, gathered):
-                state.randao_mixes[i] = spec.Bytes32(_words_to_root(words))
-                if pre_mixes is not None:
-                    pre_mixes[i] = words
-    else:
-        mixes = np.asarray(dev.randao_mixes)
-        moved += mixes.nbytes
-        if pre_mixes is not None:
-            # epoch processing touches at most one mix slot per epoch; diff
-            # and write only the changed rows (65536 Bytes32 writes -> ~1)
-            changed_rows = np.nonzero((mixes != pre_mixes).any(axis=1))[0].tolist()
-            pre_mixes[:] = mixes
-        else:
-            changed_rows = range(mixes.shape[0])
-        for i in changed_rows:
-            state.randao_mixes[i] = spec.Bytes32(_words_to_root(mixes[i]))
-    for i, b in enumerate(np.asarray(dev.justification_bits)):
-        state.justification_bits[i] = bool(b)
-    state.previous_justified_checkpoint = spec.Checkpoint(
-        epoch=spec.Epoch(int(dev.prev_justified_epoch)),
-        root=spec.Root(_words_to_root(dev.prev_justified_root)),
-    )
-    state.current_justified_checkpoint = spec.Checkpoint(
-        epoch=spec.Epoch(int(dev.curr_justified_epoch)),
-        root=spec.Root(_words_to_root(dev.curr_justified_root)),
-    )
-    state.finalized_checkpoint = spec.Checkpoint(
-        epoch=spec.Epoch(int(dev.finalized_epoch)),
-        root=spec.Root(_words_to_root(dev.finalized_root)),
-    )
-    # Re-key the memoized columns to the post-epoch registry root (the root
-    # is incremental: only the mutated validators' paths rehash here).
-    vals.__dict__["_engine_cols"] = (vals.hash_tree_root(), pre_cols)
-    return {"moved_bytes": moved, "full_bytes": full, "clean_cols": clean}
+    staged = call_with_retry(
+        lambda: _stage_write_back(spec, state, dev, pre_cols, pre_mixes,
+                                  dirty, mix_rows),
+        retry_policy or DEVICE_POLICY)
+    return _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
 
 
 def install_next_sync_committee(spec, state, active, eff, seed: bytes) -> None:
@@ -271,8 +355,95 @@ def _rotate_sync_committees(spec, state) -> None:
     install_next_sync_committee(spec, state, active, eff, bytes(seed))
 
 
+# Module-global breaker for the sequential engine path: consecutive
+# epoch-level device failures trip it OPEN; while open each epoch costs one
+# half-open probe instead of a full retry budget (robustness/breaker.py).
+_DEVICE_BREAKER = CircuitBreaker(failure_threshold=3)
+
+
+def device_breaker() -> CircuitBreaker:
+    return _DEVICE_BREAKER
+
+
+def reset_device_breaker() -> None:
+    """Re-arm the global breaker and drop its event log (test isolation)."""
+    _DEVICE_BREAKER.reset()
+
+
+def _read_aux_flags(aux, policy) -> np.ndarray:
+    """Validated dirty_cols readout (the sequential-path slice of
+    resident._read_aux): the corruption seam models a torn D2H flag copy,
+    caught structurally and re-read — the device array is intact."""
+    def attempt():
+        flags = rfaults.corrupt_array("bridge.aux_readout",
+                                      np.asarray(aux.dirty_cols))
+        if flags.dtype != np.bool_ or flags.shape != (len(DIRTY_TRACKED),):
+            raise rfaults.CorruptAuxError(
+                f"aux.dirty_cols: got {flags.dtype}{flags.shape}, expected "
+                f"bool({len(DIRTY_TRACKED)},)")
+        return flags
+
+    return call_with_retry(attempt, policy)
+
+
+def _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
+                        policy, marker) -> None:
+    """The device epoch path, failure-ordered so degradation stays safe:
+    every transient failure point (dispatch, aux readout, write-back
+    staging) precedes the commit. `marker["committed"]` flips right before
+    the first host-state mutation — past it, errors propagate instead of
+    degrading (re-running process_epoch on a half-written state would
+    corrupt it)."""
+    import jax
+
+    tick = stage_timer or (lambda name: None)
+    dev, cfg, pre_cols = state_to_device_with_columns(spec, state)
+    pre_mixes = np.array(dev.randao_mixes)  # writable: the commit updates it
+    tick("bridge_in")
+
+    def attempt_dispatch():
+        # The seam fires BEFORE the donating call, while `dev` is intact —
+        # the only point where a retry is safe (see resident._dispatch).
+        rfaults.fire("bridge.dispatch")
+        return epoch_fn_for(cfg)(dev)
+
+    dev_out, aux = call_with_retry(attempt_dispatch, policy)
+    if stage_timer is not None:
+        jax.block_until_ready(dev_out.balances)
+    tick("device")
+    if dirty_aware:
+        flags = _read_aux_flags(aux, policy)
+        dirty = {name: bool(f) for name, f in zip(DIRTY_TRACKED, flags)}
+        # The only mix row an epoch transition can write is the one for the
+        # epoch being entered: next_epoch % EPOCHS_PER_HISTORICAL_VECTOR.
+        next_epoch = int(state.slot) // int(spec.SLOTS_PER_EPOCH) + 1
+        mix_rows = [next_epoch % int(spec.EPOCHS_PER_HISTORICAL_VECTOR)]
+    else:
+        dirty = None
+        mix_rows = None
+    staged = call_with_retry(
+        lambda: _stage_write_back(spec, state, dev_out, pre_cols, pre_mixes,
+                                  dirty, mix_rows),
+        policy)
+    marker["committed"] = True
+    wb = _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
+    if stats is not None:
+        stats.update(wb)
+    if bool(aux.eth1_votes_reset):
+        state.eth1_data_votes = type(state.eth1_data_votes)()
+    if bool(aux.historical_append):
+        state.historical_roots.append(
+            spec.Root(
+                _words_to_root(historical_batch_root(dev_out.block_roots, dev_out.state_roots))
+            )
+        )
+    if bool(aux.sync_committee_update):
+        _rotate_sync_committees(spec, state)
+    tick("write_back")
+
+
 def apply_epoch_via_engine(spec, state, stage_timer=None, dirty_aware=True,
-                           stats=None) -> None:
+                           stats=None, breaker=None) -> None:
     """Mutating `process_epoch` replacement running the device engine.
 
     `stage_timer(name)`: optional callable invoked after each stage —
@@ -287,39 +458,33 @@ def apply_epoch_via_engine(spec, state, stage_timer=None, dirty_aware=True,
     for the differential tests and the bench's comparison lane).
 
     `stats`: optional dict updated with the write-back transfer accounting
-    ({"moved_bytes", "full_bytes", "clean_cols"})."""
-    import jax
+    ({"moved_bytes", "full_bytes", "clean_cols"}; on a degraded epoch,
+    {"degraded": True, "degraded_error": ...} instead).
 
-    tick = stage_timer or (lambda name: None)
-    dev, cfg, pre_cols = state_to_device_with_columns(spec, state)
-    pre_mixes = np.array(dev.randao_mixes)  # writable: _write_back updates it
-    tick("bridge_in")
-    dev_out, aux = epoch_fn_for(cfg)(dev)
-    if stage_timer is not None:
-        jax.block_until_ready(dev_out.balances)
-    tick("device")
-    if dirty_aware:
-        flags = np.asarray(aux.dirty_cols)
-        dirty = {name: bool(f) for name, f in zip(DIRTY_TRACKED, flags)}
-        # The only mix row an epoch transition can write is the one for the
-        # epoch being entered: next_epoch % EPOCHS_PER_HISTORICAL_VECTOR.
-        next_epoch = int(state.slot) // int(spec.SLOTS_PER_EPOCH) + 1
-        mix_rows = [next_epoch % int(spec.EPOCHS_PER_HISTORICAL_VECTOR)]
+    FAULT TOLERANCE: device failures (transient dispatch errors, torn aux
+    or write-back transfers — anything `retry.is_device_failure` accepts,
+    BEFORE the commit point) first burn the retry budget, then DEGRADE the
+    epoch to the pure-Python `spec.process_epoch` path, which the
+    differential conformance tests prove bit-identical. `breaker` (default:
+    the module-global instance) counts consecutive failures: at its
+    threshold it opens, and each following epoch issues a single half-open
+    probe of the device path — success re-arms it, so a recovered device
+    is back in service within one epoch."""
+    brk = _DEVICE_BREAKER if breaker is None else breaker
+    mode = brk.on_attempt()
+    policy = PROBE_POLICY if mode == "probe" else DEVICE_POLICY
+    marker = {"committed": False}
+    try:
+        _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
+                            policy, marker)
+    except Exception as exc:
+        if marker["committed"] or not is_device_failure(exc):
+            raise
+        brk.record_failure()
+        # Degraded epoch: state is unmutated (every failure path above
+        # precedes the commit), so the pure-Python spec path runs clean.
+        spec.process_epoch(state)
+        if stats is not None:
+            stats.update({"degraded": True, "degraded_error": repr(exc)})
     else:
-        dirty = None
-        mix_rows = None
-    wb = _write_back(spec, state, dev_out, pre_cols, pre_mixes,
-                     dirty=dirty, mix_rows=mix_rows)
-    if stats is not None:
-        stats.update(wb)
-    if bool(aux.eth1_votes_reset):
-        state.eth1_data_votes = type(state.eth1_data_votes)()
-    if bool(aux.historical_append):
-        state.historical_roots.append(
-            spec.Root(
-                _words_to_root(historical_batch_root(dev_out.block_roots, dev_out.state_roots))
-            )
-        )
-    if bool(aux.sync_committee_update):
-        _rotate_sync_committees(spec, state)
-    tick("write_back")
+        brk.record_success()

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..robustness import faults as rfaults
+from ..robustness.retry import DEVICE_POLICY, call_with_retry, is_retryable
 from . import bridge
 from .epoch import historical_batch_root, make_epoch_fn
 from .state import DIRTY_TRACKED, EpochConfig
@@ -83,10 +85,20 @@ def _start_host_copies(aux) -> None:
     """Queue async D2H copies of every EpochAux leaf right behind the launch
     that produces them, so the later np.asarray readout in _flush_pending
     completes the transfers instead of starting them (overlap with whatever
-    the host does in between). No-op on backends without the API."""
-    for leaf in jax.tree_util.tree_leaves(aux):
-        if hasattr(leaf, "copy_to_host_async"):
-            leaf.copy_to_host_async()
+    the host does in between). No-op on backends without the API.
+
+    Failures here DEGRADE instead of propagating: the async staging is a
+    latency optimization, and when it is skipped the flush's np.asarray
+    performs the same transfer synchronously. Only retryable (transient /
+    link-level) errors are swallowed — a host-code bug still raises."""
+    try:
+        rfaults.fire("engine.host_copy")
+        for leaf in jax.tree_util.tree_leaves(aux):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+    except Exception as exc:
+        if not is_retryable(exc):
+            raise
 
 
 class ResidentEpochEngine:
@@ -130,6 +142,59 @@ class ResidentEpochEngine:
         # and eagerly when the segment fires a sync-committee rotation.
         self._pending = None
         self._deferred_epochs = 0
+        # Device-boundary retry budget (robustness/retry.py): governs the
+        # dispatch re-issue and the validated aux re-read. Swappable per
+        # engine so tests can zero the backoff.
+        self.retry_policy = DEVICE_POLICY
+
+    def _dispatch(self, fn, arg):
+        """Issue a (donating) jitted step under the retry policy.
+
+        The injection seam fires BEFORE the call, while the input pytree
+        is intact — that is the only point where a retry is safe, because
+        the program donates its input and a genuine mid-execution failure
+        leaves the buffers deleted. Such a failure's retry raises the
+        deleted-buffer XlaRuntimeError and exhausts the budget; callers
+        with a fallback (bridge.apply_epoch_via_engine) degrade then."""
+        def attempt():
+            rfaults.fire("engine.dispatch")
+            return fn(arg)
+
+        return call_with_retry(attempt, self.retry_policy)
+
+    def _read_aux(self, aux):
+        """Validated host readout of an EpochAux segment.
+
+        Each flag array crosses the corruption seam and then structural
+        validation (bool dtype, coherent shapes) — the failure mode is a
+        torn/garbled D2H copy, which is retryable because the device
+        arrays are intact and np.asarray simply re-reads them. Returns
+        (eth1_resets, hist_appends, sync_updates, dirty_cols) with the
+        flag arrays (seg,) and dirty_cols (seg, len(DIRTY_TRACKED))."""
+        def attempt():
+            e = rfaults.corrupt_array(
+                "engine.aux_readout", np.asarray(aux.eth1_votes_reset))
+            h = rfaults.corrupt_array(
+                "engine.aux_readout", np.asarray(aux.historical_append))
+            s = rfaults.corrupt_array(
+                "engine.aux_readout", np.asarray(aux.sync_committee_update))
+            d = rfaults.corrupt_array(
+                "engine.aux_readout", np.asarray(aux.dirty_cols))
+            e, h, s = (np.atleast_1d(x) for x in (e, h, s))
+            d = np.atleast_2d(d)
+            for name, arr in (("eth1_votes_reset", e), ("historical_append", h),
+                              ("sync_committee_update", s), ("dirty_cols", d)):
+                if arr.dtype != np.bool_:
+                    raise rfaults.CorruptAuxError(
+                        f"aux.{name}: expected bool dtype, got {arr.dtype}")
+            if not (e.shape == h.shape == s.shape
+                    and d.shape == e.shape + (len(DIRTY_TRACKED),)):
+                raise rfaults.CorruptAuxError(
+                    "aux flag shapes incoherent: "
+                    f"{e.shape}/{h.shape}/{s.shape}/{d.shape}")
+            return e, h, s, d
+
+        return call_with_retry(attempt, self.retry_policy)
 
     def step_epoch(self, advance_slots: bool = True) -> None:
         """One epoch transition; host work is O(1) except on period
@@ -147,17 +212,12 @@ class ResidentEpochEngine:
             # per-slot mode interleaves advance_slot's root-vector writes
             # with epoch steps, so nothing may stay deferred across one.
             self._flush_pending()
-            self.dev, aux = self._step(self.dev)
-            self._service_segment(
-                np.asarray(aux.eth1_votes_reset)[None],
-                np.asarray(aux.historical_append)[None],
-                np.asarray(aux.sync_committee_update)[None],
-                dirty_cols=np.asarray(aux.dirty_cols)[None],
-                advance_slots=False,
-            )
+            self.dev, aux = self._dispatch(self._step, self.dev)
+            e, h, s, d = self._read_aux(aux)
+            self._service_segment(e, h, s, dirty_cols=d, advance_slots=False)
             return
         cur = int(self.state.slot) // self.cfg.slots_per_epoch + self._deferred_epochs
-        self.dev, aux = self._step(self.dev)
+        self.dev, aux = self._dispatch(self._step, self.dev)
         _start_host_copies(aux)
         self._flush_pending()  # previous epoch's epilogues overlap this launch
         self._pending = aux
@@ -174,13 +234,8 @@ class ResidentEpochEngine:
             return
         self._pending = None
         self._deferred_epochs = 0
-        d = np.asarray(aux.dirty_cols)
-        self._service_segment(
-            np.atleast_1d(np.asarray(aux.eth1_votes_reset)),
-            np.atleast_1d(np.asarray(aux.historical_append)),
-            np.atleast_1d(np.asarray(aux.sync_committee_update)),
-            dirty_cols=d[None] if d.ndim == 1 else d,
-        )
+        e, h, s, d = self._read_aux(aux)
+        self._service_segment(e, h, s, dirty_cols=d)
 
     def _service_segment(self, eth1_resets, hist_appends, sync_updates,
                          dirty_cols=None, advance_slots: bool = True) -> None:
@@ -267,7 +322,8 @@ class ResidentEpochEngine:
                    + self._deferred_epochs)
             to_boundary = period - 1 - (cur % period) + 1  # epochs incl. the one firing rotation
             seg = min(k - done, to_boundary)
-            self.dev, auxes = resident_scan_fn_for(self.cfg, seg)(self.dev)
+            self.dev, auxes = self._dispatch(
+                resident_scan_fn_for(self.cfg, seg), self.dev)
             _start_host_copies(auxes)
             self._flush_pending()  # previous segment overlaps this launch
             self._pending = auxes
@@ -334,15 +390,21 @@ class ResidentEpochEngine:
         # so the transfers run while the host loop reconstructs earlier
         # columns (np.asarray in _write_back then completes, not starts,
         # each copy). randao is excluded when row-gathered.
-        for name, isdirty in dirty.items():
-            if not isdirty or (name == "randao_mixes" and mix_rows is not None):
-                continue
-            arr = getattr(self.dev, name)
-            if hasattr(arr, "copy_to_host_async"):
-                arr.copy_to_host_async()
+        try:
+            rfaults.fire("engine.host_copy")
+            for name, isdirty in dirty.items():
+                if not isdirty or (name == "randao_mixes" and mix_rows is not None):
+                    continue
+                arr = getattr(self.dev, name)
+                if hasattr(arr, "copy_to_host_async"):
+                    arr.copy_to_host_async()
+        except Exception as exc:
+            # staging is a latency optimization; _write_back reads sync
+            if not is_retryable(exc):
+                raise
         stats = bridge._write_back(
             self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes,
-            dirty=dirty, mix_rows=mix_rows)
+            dirty=dirty, mix_rows=mix_rows, retry_policy=self.retry_policy)
         self._dirty[:] = False
         self._epochs_since_sync = 0
         return stats

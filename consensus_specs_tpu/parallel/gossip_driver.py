@@ -33,6 +33,8 @@ import struct
 import threading
 from dataclasses import dataclass, field
 
+from ..robustness import faults as rfaults
+
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 _LEN = struct.Struct("<I")
@@ -40,6 +42,22 @@ _LEN = struct.Struct("<I")
 # payload a gossip message may declare — passed to snappy.decompress so a
 # crafted preamble is rejected at the protocol bound, not the 1 GiB backstop.
 MAX_MESSAGE_SIZE = 1 << 20
+# Wire-frame bound: a frame carries one snappy-compressed message, and snappy
+# BLOCK compression expands incompressible input by at most ~1/6 + constant,
+# so any frame larger than this cannot decompress to <= MAX_MESSAGE_SIZE. A
+# bigger declared length is a framing attack or a desynced stream — without
+# the bound, one crafted 4-byte header makes _recv_exact buffer (up to) 4 GiB
+# from a hostile peer before decode even runs.
+MAX_WIRE_FRAME = MAX_MESSAGE_SIZE + MAX_MESSAGE_SIZE // 6 + 64
+# rx socket timeout: a peer that stops sending mid-frame cannot pin the rx
+# thread (and whatever waits on its stats) forever.
+RECV_TIMEOUT = 30.0
+
+
+class FrameError(ValueError):
+    """Framing-level violation (oversized declared length). Once the length
+    prefix cannot be trusted there is no way to find the next frame boundary
+    — the connection must be dropped, not resynced."""
 
 
 def message_id(ssz_bytes: bytes) -> bytes:
@@ -92,11 +110,16 @@ def send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
-def recv_frame(sock: socket.socket) -> bytes | None:
+def recv_frame(sock: socket.socket,
+               max_frame: int = MAX_WIRE_FRAME) -> bytes | None:
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
     (n,) = _LEN.unpack(header)
+    if n > max_frame:
+        raise FrameError(
+            f"declared frame length {n} exceeds the {max_frame}-byte wire "
+            "bound")
     return _recv_exact(sock, n)
 
 
@@ -119,7 +142,11 @@ class NodeStats:
     received: int = 0
     duplicates: int = 0
     verified_batches: int = 0
+    malformed: int = 0  # frames/messages quarantined instead of delivered
     message_ids: set = field(default_factory=set)
+    # (reason, payload head) of recent malformed frames — enough to
+    # attribute a misbehaving peer in a postmortem, bounded memory.
+    quarantined: list = field(default_factory=list)
 
 
 class GossipNode:
@@ -154,16 +181,38 @@ class GossipNode:
             s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
             self._links.append(s)
 
+    def _quarantine(self, reason: str, wire: bytes) -> None:
+        """Count + quarantine a malformed frame instead of letting it raise
+        out of the rx loop (one bad peer must not kill message collection
+        for every well-behaved one)."""
+        with self._lock:
+            self.stats.malformed += 1
+            self.stats.quarantined.append((reason, bytes(wire[:64])))
+            del self.stats.quarantined[:-32]  # keep the most recent 32
+
     def _rx_loop(self, conn: socket.socket) -> None:
-        conn.settimeout(30.0)
+        conn.settimeout(RECV_TIMEOUT)
         while not self._stop.is_set():
             try:
                 wire = recv_frame(conn)
+            except FrameError as exc:
+                # length prefix can't be trusted -> the stream has no
+                # recoverable frame boundary: quarantine and drop the link
+                self._quarantine(f"frame: {exc}", b"")
+                break
             except (TimeoutError, OSError):
                 break
             if wire is None:
                 break
-            ssz = decode_message(wire)
+            wire = rfaults.mangle_bytes("gossip.recv_frame", wire)
+            try:
+                ssz = decode_message(wire)
+            except (ValueError, IndexError) as exc:
+                # truncated/garbled snappy payload: the FRAME was still
+                # length-delimited, so the stream is in sync — quarantine
+                # the message, keep the connection
+                self._quarantine(f"decode: {type(exc).__name__}: {exc}", wire)
+                continue
             mid = message_id(ssz)
             with self._lock:
                 if mid in self.stats.message_ids:

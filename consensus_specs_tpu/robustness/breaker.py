@@ -1,0 +1,80 @@
+"""Circuit breaker for the device epoch path.
+
+`bridge.apply_epoch_via_engine` must complete every epoch even when the
+accelerator is gone (tunnel drop, preemption): a failed device attempt
+degrades that epoch to the pure-Python spec path (`spec.process_epoch`),
+which the differential tests prove bit-identical. The breaker bounds what
+the degraded steady state COSTS:
+
+  closed      device path with the full retry budget.
+  open        reached after `failure_threshold` consecutive epoch-level
+              device failures; the very next epoch transitions to...
+  half_open   ...a single-attempt probe of the device path. Success
+              re-arms (closed, counter reset); failure re-opens, so a dead
+              device costs one cheap probe per epoch instead of a full
+              retry budget, while recovery is detected within one epoch.
+
+Every transition and degraded epoch is recorded in `events` — liveness
+under partial failure is only worth having if it is observable.
+
+jax-free at module level (tpulint import-layering).
+"""
+from __future__ import annotations
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 3, name: str = "device-epoch"):
+        self.failure_threshold = int(failure_threshold)
+        self.name = name
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.degraded_epochs = 0
+        self.events: list[dict] = []
+
+    def on_attempt(self) -> str:
+        """Call once per epoch before trying the device path. Returns the
+        attempt mode: "closed" (full retry budget) or "probe" (single
+        attempt; the breaker is half-open)."""
+        if self.state == OPEN:
+            self.state = HALF_OPEN
+            self._log("half_open_probe")
+        return "probe" if self.state == HALF_OPEN else "closed"
+
+    def record_success(self) -> None:
+        if self.state != CLOSED:
+            self._log("rearmed")
+        self.state = CLOSED
+        self.consecutive_failures = 0
+
+    def record_failure(self, degraded: bool = True) -> None:
+        self.consecutive_failures += 1
+        if degraded:
+            self.degraded_epochs += 1
+            self._log("degraded_to_python")
+        if self.state == HALF_OPEN or \
+                self.consecutive_failures >= self.failure_threshold:
+            if self.state != OPEN:
+                self._log("opened")
+            self.state = OPEN
+
+    def reset(self) -> None:
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.degraded_epochs = 0
+        self.events.clear()
+
+    def _log(self, event: str) -> None:
+        self.events.append({
+            "event": event,
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+        })
+
+    def __repr__(self) -> str:  # observability in test failures
+        return (f"CircuitBreaker({self.name!r}, state={self.state}, "
+                f"failures={self.consecutive_failures}, "
+                f"degraded={self.degraded_epochs})")

@@ -1,0 +1,267 @@
+"""Epoch-boundary checkpoints of the resident engine, with integrity digest.
+
+A `ResidentEpochEngine` holds state in four places: the device `EpochState`
+pytree, the host `BeaconState` mirror (stale except for epilogue-owned
+fields), the write-back diff bases (`_pre_cols` / `_pre_mixes`), and the
+incremental-root level arrays. A crash loses the device half; a checkpoint
+makes the whole thing reconstructible:
+
+  state_ssz   the host BeaconState, SSZ-serialized (canonical encoding).
+  dev         every EpochState field as an owning numpy copy.
+  pre_cols /  the registry diff bases the write-back maintains — snapshot
+  pre_mixes   together with the host state so diffs stay coherent.
+  meta        the pending-service bookkeeping: dirty-column accumulator,
+              epochs since last sync, owed incremental-root refreshes.
+  inc         the incremental-root Merkle stack (level arrays, cached
+              columns, light roots), so `state_root()` resumes without a
+              full rebuild. Captured when built; restore leaves it lazy
+              otherwise.
+
+`capture()` first flushes the engine's deferred epilogue service so the
+pending queue is empty by construction — a checkpoint is always a clean
+epoch boundary. The digest (sha256 over the canonical flattening) makes a
+bit-rotted or tampered snapshot fail loudly at `restore()` instead of
+resuming from garbage.
+
+jax-free at module level (tpulint import-layering): everything touching
+jax or the engine is deferred into capture()/restore().
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional
+
+import numpy as np
+
+FORMAT = "engine-checkpoint-v1"
+
+
+class CheckpointIntegrityError(Exception):
+    """The snapshot's content no longer matches its digest."""
+
+
+# --- host<->device tree helpers ---------------------------------------------
+
+
+def _to_host(x):
+    """Owning numpy copies of every array leaf (device buffers are donated
+    by the next step, so references into them would dangle)."""
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, tuple):
+        return tuple(_to_host(v) for v in x)
+    if isinstance(x, list):
+        return [_to_host(v) for v in x]
+    if isinstance(x, dict):
+        return {k: _to_host(v) for k, v in x.items()}
+    return np.array(x)
+
+
+def _to_dev(x, jnp):
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, tuple):
+        return tuple(_to_dev(v, jnp) for v in x)
+    if isinstance(x, list):
+        return [_to_dev(v, jnp) for v in x]
+    if isinstance(x, dict):
+        return {k: _to_dev(v, jnp) for k, v in x.items()}
+    return jnp.array(x)
+
+
+# --- canonical flattening (digest + disk format share it) --------------------
+
+
+def _flatten(x, prefix: str, arrays: dict):
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, np.ndarray):
+        arrays[prefix] = x
+        return {"$nd": prefix}
+    if isinstance(x, tuple):
+        return {"$tuple": [_flatten(v, f"{prefix}/{i}", arrays)
+                           for i, v in enumerate(x)]}
+    if isinstance(x, list):
+        return {"$list": [_flatten(v, f"{prefix}/{i}", arrays)
+                          for i, v in enumerate(x)]}
+    if isinstance(x, dict):
+        return {"$dict": {k: _flatten(v, f"{prefix}/{k}", arrays)
+                          for k, v in sorted(x.items())}}
+    raise TypeError(f"unsupported checkpoint leaf at {prefix}: {type(x)!r}")
+
+
+def _unflatten(skel, arrays: dict):
+    if not isinstance(skel, dict):
+        return skel
+    if "$nd" in skel:
+        return arrays[skel["$nd"]]
+    if "$tuple" in skel:
+        return tuple(_unflatten(v, arrays) for v in skel["$tuple"])
+    if "$list" in skel:
+        return [_unflatten(v, arrays) for v in skel["$list"]]
+    return {k: _unflatten(v, arrays) for k, v in skel["$dict"].items()}
+
+
+# --- the checkpoint ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EngineCheckpoint:
+    state_ssz: bytes
+    dev: dict
+    pre_cols: dict
+    pre_mixes: Optional[np.ndarray]
+    meta: dict
+    inc: Optional[dict]
+    digest: str = ""
+
+    # -- digest ---------------------------------------------------------------
+
+    def _payload(self) -> dict:
+        return {"dev": self.dev, "pre_cols": self.pre_cols,
+                "pre_mixes": self.pre_mixes, "meta": self.meta,
+                "inc": self.inc}
+
+    def compute_digest(self) -> str:
+        arrays: dict = {}
+        skel = _flatten(self._payload(), "", arrays)
+        h = hashlib.sha256()
+        h.update(FORMAT.encode())
+        h.update(len(self.state_ssz).to_bytes(8, "little"))
+        h.update(self.state_ssz)
+        h.update(json.dumps(skel, sort_keys=True).encode())
+        for key in sorted(arrays):
+            a = np.ascontiguousarray(arrays[key])
+            h.update(f"{key}:{a.dtype.str}:{a.shape}".encode())
+            h.update(a.tobytes())
+        return h.hexdigest()
+
+    def verify(self) -> None:
+        actual = self.compute_digest()
+        if actual != self.digest:
+            raise CheckpointIntegrityError(
+                f"checkpoint digest mismatch: recorded {self.digest[:16]}…, "
+                f"content hashes to {actual[:16]}… — refusing to restore "
+                "from a torn or tampered snapshot")
+
+    # -- capture --------------------------------------------------------------
+
+    @classmethod
+    def capture(cls, engine) -> "EngineCheckpoint":
+        """Snapshot at a clean epoch boundary (deferred service flushed)."""
+        engine._flush_pending()
+        dev = {f.name: np.array(getattr(engine.dev, f.name))
+               for f in dataclasses.fields(type(engine.dev))}
+        meta = {
+            "format": FORMAT,
+            "fork": str(getattr(engine.spec, "fork", "")),
+            "dirty": [bool(b) for b in engine._dirty],
+            "epochs_since_sync": int(engine._epochs_since_sync),
+            "pending_epochs": int(engine._pending_epochs),
+            "pending_last_epoch": int(engine._pending_last_epoch),
+        }
+        inc = None
+        if engine._inc is not None:
+            inc = {k: _to_host(v) for k, v in vars(engine._inc).items()}
+        ckpt = cls(
+            state_ssz=bytes(engine.state.encode_bytes()),
+            dev=dev,
+            pre_cols={k: np.array(v) for k, v in engine._pre_cols.items()},
+            pre_mixes=(None if engine._pre_mixes is None
+                       else np.array(engine._pre_mixes)),
+            meta=meta,
+            inc=inc,
+        )
+        ckpt.digest = ckpt.compute_digest()
+        return ckpt
+
+    # -- restore --------------------------------------------------------------
+
+    def restore(self, spec):
+        """Rebuild a ResidentEpochEngine equivalent to the captured one.
+
+        Verifies the digest first; decodes the host state from SSZ; device
+        arrays re-enter through jnp.array (jax-owned copies — the donation
+        discipline from bridge.state_to_device_with_columns applies to a
+        restore exactly as to a fresh bridge-in)."""
+        self.verify()
+        fork = str(getattr(spec, "fork", ""))
+        if self.meta.get("fork") and fork and self.meta["fork"] != fork:
+            raise CheckpointIntegrityError(
+                f"checkpoint captured under fork {self.meta['fork']!r}, "
+                f"restore attempted with {fork!r}")
+        import jax.numpy as jnp
+
+        from ..engine.incremental_root import IncrementalStateRoot
+        from ..engine.resident import ResidentEpochEngine, resident_step_fn_for
+        from ..engine.state import EpochConfig, EpochState
+        from . import retry as _retry
+
+        state = spec.BeaconState.decode_bytes(self.state_ssz)
+        eng = object.__new__(ResidentEpochEngine)
+        eng.spec = spec
+        eng.state = state
+        eng.cfg = EpochConfig.from_spec(spec)
+        eng.dev = EpochState(**{k: jnp.array(v) for k, v in self.dev.items()})
+        eng._pre_cols = {k: np.array(v) for k, v in self.pre_cols.items()}
+        eng._pre_mixes = (None if self.pre_mixes is None
+                          else np.array(self.pre_mixes))
+        eng._step = resident_step_fn_for(eng.cfg)
+        eng._dirty = np.array(self.meta["dirty"], dtype=bool)
+        eng._epochs_since_sync = int(self.meta["epochs_since_sync"])
+        eng._pending_epochs = int(self.meta["pending_epochs"])
+        eng._pending_last_epoch = int(self.meta["pending_last_epoch"])
+        eng._pending = None
+        eng._deferred_epochs = 0
+        eng.retry_policy = _retry.DEVICE_POLICY
+        eng._inc = None
+        if self.inc is not None:
+            inc = object.__new__(IncrementalStateRoot)
+            inc.__dict__.update(
+                {k: _to_dev(v, jnp) for k, v in self.inc.items()})
+            eng._inc = inc
+        return eng
+
+    # -- disk format ----------------------------------------------------------
+
+    def save(self, path) -> None:
+        arrays: dict = {}
+        skel = _flatten(self._payload(), "", arrays)
+        manifest = json.dumps({"format": FORMAT, "digest": self.digest,
+                               "skeleton": skel}, sort_keys=True)
+        np.savez_compressed(
+            path,
+            __manifest__=np.frombuffer(manifest.encode(), dtype=np.uint8),
+            __state_ssz__=np.frombuffer(self.state_ssz, dtype=np.uint8),
+            **{f"a{i}": arrays[k] for i, k in enumerate(sorted(arrays))},
+        )
+
+    @classmethod
+    def load(cls, path) -> "EngineCheckpoint":
+        with np.load(path, allow_pickle=False) as z:
+            manifest = json.loads(bytes(z["__manifest__"]).decode())
+            if manifest.get("format") != FORMAT:
+                raise CheckpointIntegrityError(
+                    f"not a {FORMAT} file: {manifest.get('format')!r}")
+            state_ssz = bytes(z["__state_ssz__"])
+            arrays_by_key: dict = {}
+            keys: dict = {}
+
+            def collect(skel):
+                if isinstance(skel, dict):
+                    if "$nd" in skel:
+                        keys[skel["$nd"]] = None
+                    else:
+                        for v in (skel.get("$tuple") or skel.get("$list")
+                                  or list(skel.get("$dict", {}).values())):
+                            collect(v)
+
+            collect(manifest["skeleton"])
+            for i, k in enumerate(sorted(keys)):
+                arrays_by_key[k] = np.array(z[f"a{i}"])
+        payload = _unflatten(manifest["skeleton"], arrays_by_key)
+        ckpt = cls(state_ssz=state_ssz, digest=manifest["digest"], **payload)
+        ckpt.verify()
+        return ckpt

@@ -1,0 +1,112 @@
+"""Bounded retry with exponential backoff + deterministic jitter.
+
+One policy surface for every seam that can fail transiently: the resident
+engine's dispatch and aux readout, the bridge's write-back staging, the
+deferred-BLS flush, the gossip sockets, and tools/bench_probe.py's TPU
+probe loop. Classification is centralized here so "what is worth retrying"
+is one decision, not five ad-hoc try/excepts:
+
+  retryable   injected TransientFaults, IntegrityErrors (the device source
+              is intact — re-reading is safe), XlaRuntimeError (matched by
+              MRO *name* so this module never imports jax), socket/OS
+              timeouts, and anything carrying `retryable = True`.
+  fatal       everything else — assertion failures, BLSVerificationError,
+              host-code bugs, and `FatalFault` (the injected hard crash).
+
+Donation caveat: the jitted epoch programs donate their input pytree, so a
+dispatch that fails AFTER consuming its buffers cannot be re-issued — the
+second attempt would read deleted memory. The injection seams therefore
+fire BEFORE the real call (input intact, retry safe), and a genuine
+post-donation failure surfaces as a deleted-buffer XlaRuntimeError whose
+retry fails identically and falls through to degradation.
+
+jax-free at module level (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from random import Random
+from typing import Callable, Optional
+
+from .faults import FaultInjected
+
+# Exception type NAMES that classify as retryable device failures; matching
+# by __mro__ name keeps this module importable without jax. JaxRuntimeError
+# is jax's alias whose underlying class is named XlaRuntimeError.
+_RETRYABLE_TYPE_NAMES = frozenset({"XlaRuntimeError", "JaxRuntimeError"})
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """True when retrying the failed operation can plausibly succeed."""
+    marked = getattr(exc, "retryable", None)
+    if marked is not None:
+        return bool(marked)
+    if isinstance(exc, (TimeoutError, ConnectionError, OSError)):
+        return True
+    return any(t.__name__ in _RETRYABLE_TYPE_NAMES for t in type(exc).__mro__)
+
+
+def is_device_failure(exc: BaseException) -> bool:
+    """Failures eligible for device→host degradation (circuit-breaker
+    accounting): anything retryable plus injected fatals — a crashed
+    dispatch is a *device* problem, not a host-code bug, even when it is
+    not worth re-issuing."""
+    return is_retryable(exc) or isinstance(exc, FaultInjected)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with multiplicative jitter.
+
+    max_attempts  total attempts including the first; 0 = unbounded.
+    base_delay    delay after the first failure (seconds).
+    backoff       delay multiplier per subsequent failure.
+    max_delay     backoff ceiling (pre-jitter).
+    jitter        fraction of the delay added uniformly at random, from a
+                  stream seeded by `seed` — deterministic across runs.
+    """
+
+    max_attempts: int = 4
+    base_delay: float = 0.02
+    backoff: float = 2.0
+    max_delay: float = 0.5
+    jitter: float = 0.5
+    seed: int = 0
+
+    def delay(self, attempt: int, rng: Random) -> float:
+        d = min(self.max_delay, self.base_delay * self.backoff ** (attempt - 1))
+        if self.jitter:
+            d *= 1.0 + self.jitter * rng.random()
+        return d
+
+
+# Shared defaults: device-boundary ops are cheap to re-issue, so short
+# delays and a small budget; exhausting it falls through to degradation.
+DEVICE_POLICY = RetryPolicy(max_attempts=4, base_delay=0.02, max_delay=0.5)
+# The half-open probe gets exactly one attempt (see breaker.py).
+PROBE_POLICY = RetryPolicy(max_attempts=1)
+
+
+def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None, *,
+                    classify: Callable = is_retryable,
+                    sleep: Callable = time.sleep,
+                    on_retry: Optional[Callable] = None):
+    """Run `fn()` under `policy`; re-raise the final failure unchanged.
+
+    `classify(exc)` decides retry-vs-raise; `on_retry(attempt, exc)` runs
+    before each backoff sleep (logging / provenance hooks)."""
+    policy = policy or DEVICE_POLICY
+    rng = Random(policy.seed)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as exc:
+            exhausted = policy.max_attempts and attempt >= policy.max_attempts
+            if exhausted or not classify(exc):
+                raise
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            sleep(policy.delay(attempt, rng))

@@ -32,6 +32,35 @@ def get_balance(state, index):
     return state.balances[index]
 
 
+def prepared_epoch_state(spec, start_epoch: int, seed: int):
+    """A randomized state parked at the LAST slot of `start_epoch` (where
+    process_epoch runs), with per-validator balances/participation/
+    inactivity scrambled and a justifiable checkpoint pair — the shared
+    setup of the engine differential suites (test_resident_engine,
+    test_robustness, test_chaos_epoch). start_epoch=6 on minimal puts
+    eth1 reset, historical append, and sync rotation boundaries within a
+    9-epoch run."""
+    import random
+
+    from .genesis import create_valid_beacon_state
+
+    state = create_valid_beacon_state(spec)
+    transition_to(spec, state, start_epoch * spec.SLOTS_PER_EPOCH)
+    state.slot = spec.Slot((start_epoch + 1) * spec.SLOTS_PER_EPOCH - 1)
+    rng = random.Random(seed)
+    for i in range(len(state.validators)):
+        state.balances[i] = spec.Gwei(rng.randrange(16_000_000_000, 40_000_000_000))
+        state.previous_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.current_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
+        state.inactivity_scores[i] = spec.uint64(rng.randrange(0, 100))
+    cur = spec.get_current_epoch(state)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(max(0, int(cur) - 2)), root=state.finalized_checkpoint.root)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=spec.Epoch(max(0, int(cur) - 1)), root=state.current_justified_checkpoint.root)
+    return state
+
+
 def set_full_participation_previous_epoch(spec, state):
     """Make every active validator appear to have attested correctly for the
     previous epoch — phase0: synthetic PendingAttestations; altair family:

@@ -1,0 +1,8 @@
+"""Seeded failure shape: a fault-injection module that imports jax at module
+level to build its injected exception — poisons every jax-free consumer
+(crypto/bls.py, the gossip driver) that threads a fault seam."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def make_exc(msg):
+    return jax.errors.JaxRuntimeError(msg)

@@ -1,0 +1,13 @@
+"""Clean robustness module: classification by type NAME (no jax import at
+module level) and a deferred function-level import — the sanctioned pattern
+for a jax-free branch that still needs to manufacture a device error."""
+
+
+def is_retryable(exc):
+    return any(t.__name__ == "XlaRuntimeError" for t in type(exc).__mro__)
+
+
+def make_device_error(msg):
+    from jax.errors import JaxRuntimeError  # deferred: jax-path only
+
+    return JaxRuntimeError(msg)

@@ -251,6 +251,85 @@ def test_expand_message_xmd_structure():
     assert expand_message_xmd(b"msg", b"DST-A", 96) == a
 
 
+# --- deferral-queue hygiene under flush failure (robustness PR) --------------
+
+def test_deferred_queue_resets_after_flush_failure():
+    """Regression: a BLSVerificationError escaping the outermost __exit__
+    must leave the thread-local deferral state pristine — the next
+    deferred_verification() on this thread starts with an empty queue, not
+    the failed batch's leftovers (queue poisoning)."""
+    pk, msg = bls.SkToPk(SK1), b"queue hygiene"
+    sig = bls.Sign(SK1, msg)
+    with pytest.raises(bls.BLSVerificationError):
+        with bls.deferred_verification():
+            assert bls.Verify(pk, msg, sig) is True  # optimistic
+            assert bls.Verify(pk, b"forged", sig) is True  # fails at flush
+    assert bls._deferral.queue is None
+    assert bls._deferral.depth == 0
+    # a fresh context on the same thread flushes ONLY its own checks
+    with bls.deferred_verification():
+        assert bls.Verify(pk, msg, sig) is True
+
+
+def test_deferred_flush_retries_transient_fault():
+    """The bls.flush fault seam + FLUSH_RETRY_POLICY: one injected transient
+    failure is absorbed by the retry (same queue re-dispatched — queueing is
+    side-effect-free), and the batch still verifies."""
+    from consensus_specs_tpu.robustness.faults import FaultPlan, FaultSpec
+    from consensus_specs_tpu.robustness.retry import RetryPolicy
+
+    pk, msg = bls.SkToPk(SK1), b"transient flush"
+    sig = bls.Sign(SK1, msg)
+    saved = bls.FLUSH_RETRY_POLICY
+    bls.FLUSH_RETRY_POLICY = RetryPolicy(max_attempts=3, base_delay=0.0,
+                                         max_delay=0.0)
+    plan = FaultPlan(seed=5, sites={
+        "bls.flush": FaultSpec(kind="raise", at_calls=(1,), exc="transient"),
+    })
+    try:
+        with plan.active():
+            with bls.deferred_verification():
+                assert bls.Verify(pk, msg, sig) is True
+        assert plan.fires("bls.flush") == 1
+        assert plan.calls("bls.flush") == 2  # failed attempt + clean retry
+    finally:
+        bls.FLUSH_RETRY_POLICY = saved
+
+
+def test_deferred_flush_exhausted_retries_leaves_clean_state():
+    """When every retry attempt fails, the transient error escapes — but the
+    deferral state must STILL reset (the finally-reset, not the happy path,
+    carries the invariant)."""
+    from consensus_specs_tpu.robustness.faults import (
+        FaultPlan,
+        FaultSpec,
+        TransientFault,
+    )
+    from consensus_specs_tpu.robustness.retry import RetryPolicy
+
+    pk, msg = bls.SkToPk(SK1), b"doomed flush"
+    sig = bls.Sign(SK1, msg)
+    saved = bls.FLUSH_RETRY_POLICY
+    bls.FLUSH_RETRY_POLICY = RetryPolicy(max_attempts=2, base_delay=0.0,
+                                         max_delay=0.0)
+    plan = FaultPlan(seed=6, sites={
+        "bls.flush": FaultSpec(kind="raise", rate=1.0, exc="transient"),
+    })
+    try:
+        with plan.active():
+            with pytest.raises(TransientFault):
+                with bls.deferred_verification():
+                    assert bls.Verify(pk, msg, sig) is True
+        assert plan.calls("bls.flush") == 2  # both attempts consumed
+        assert bls._deferral.queue is None
+        assert bls._deferral.depth == 0
+        # the thread recovers: a later batch (no plan active) verifies
+        with bls.deferred_verification():
+            assert bls.Verify(pk, msg, sig) is True
+    finally:
+        bls.FLUSH_RETRY_POLICY = saved
+
+
 def test_py_backend_survives_unimportable_bls_jax():
     """ADVICE r5: a pure-Python-oracle process (no jax importable) must be
     able to Sign/Verify, defer+flush, AggregatePKs, and clear_caches without

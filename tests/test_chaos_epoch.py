@@ -1,0 +1,287 @@
+"""Chaos harness: the epoch pipeline under seeded fault schedules.
+
+The headline claims of the robustness layer, proved end to end:
+
+  1. CONVERGENCE — K epochs driven through the resident engine under a
+     fault plan hitting every seam (dispatch, aux readout, host-copy
+     staging, write-back staging + torn transfers) produce a state whose
+     hash_tree_root is BIT-IDENTICAL to the fault-free oracle's. Retries
+     and validation absorb the faults; nothing leaks into consensus state.
+  2. KILL + RESTORE — a fatal fault mid-write-back aborts materialize with
+     the host state untouched (two-phase staging), and an earlier
+     EngineCheckpoint restores an engine that re-runs to the oracle root.
+  3. DEGRADE + RE-ARM — with the device path hard-down, every epoch of
+     apply_epoch_via_engine degrades to pure-Python spec execution
+     (bit-identical by the differential suites), the circuit breaker opens
+     at its threshold, and the first fault-free epoch's half-open probe
+     re-arms it.
+
+All schedules are exact (`at_calls`) or fixed-seed, so the suite is fully
+deterministic; the long randomized soak is marked `slow`.
+"""
+import pytest
+
+from consensus_specs_tpu.compiler import get_spec
+from consensus_specs_tpu.crypto import bls
+from consensus_specs_tpu.engine import bridge
+from consensus_specs_tpu.engine.resident import ResidentEpochEngine
+from consensus_specs_tpu.robustness.breaker import CircuitBreaker
+from consensus_specs_tpu.robustness.checkpoint import EngineCheckpoint
+from consensus_specs_tpu.robustness.faults import (
+    FatalFault,
+    FaultPlan,
+    FaultSpec,
+    uninstall,
+)
+from consensus_specs_tpu.robustness.retry import RetryPolicy
+from consensus_specs_tpu.ssz import hash_tree_root
+from consensus_specs_tpu.testlib.state import prepared_epoch_state
+
+# Zero-delay budget: chaos runs exercise the retry LOGIC, not the backoff
+# wall clock.
+FAST_RETRY = RetryPolicy(max_attempts=4, base_delay=0.0, backoff=1.0,
+                         max_delay=0.0, jitter=0.0)
+
+K_EPOCHS = 9  # from epoch 6 on minimal: crosses eth1 reset, historical
+#               append, and a sync-committee rotation
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return get_spec("altair", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def _bls_off_and_clean_plan():
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        yield
+    finally:
+        bls.bls_active = was
+        uninstall()  # never leak a plan into another test
+
+
+_ORACLE_CACHE: dict = {}
+
+
+def _oracle_root(spec, seed, k=K_EPOCHS) -> bytes:
+    key = (spec.fork, seed, k)
+    if key not in _ORACLE_CACHE:
+        st = prepared_epoch_state(spec, start_epoch=6, seed=seed)
+        eng = ResidentEpochEngine(spec, st)
+        for _ in range(k):
+            eng.step_epoch()
+        eng.materialize()
+        _ORACLE_CACHE[key] = bytes(hash_tree_root(st))
+    return _ORACLE_CACHE[key]
+
+
+def test_chaos_convergence_bit_identical_root(spec):
+    """Faults at every engine seam; the final root must equal the
+    fault-free oracle bit for bit."""
+    oracle = _oracle_root(spec, seed=11)
+
+    st = prepared_epoch_state(spec, start_epoch=6, seed=11)
+    eng = ResidentEpochEngine(spec, st)
+    eng.retry_policy = FAST_RETRY
+    plan = FaultPlan(seed=0xC0FFEE, sites={
+        # transient dispatch failures: pre-donation, so the retry re-issues
+        "engine.dispatch": FaultSpec(kind="raise", at_calls=(2, 5, 6),
+                                     exc="transient"),
+        # torn aux flag copies: caught by _read_aux validation, re-read
+        "engine.aux_readout": FaultSpec(kind="corrupt", at_calls=(3, 14),
+                                        corruption="nan"),
+        # async host-copy staging failures: degraded to sync reads
+        "engine.host_copy": FaultSpec(kind="raise", at_calls=(4,),
+                                      exc="transient"),
+        # write-back staging: a torn column copy on the first attempt, a
+        # transient failure on the second — three attempts total, within
+        # budget, exercising both staging failure modes (the torn/transient
+        # call indices account for the restart re-walking the columns)
+        "bridge.write_back": FaultSpec(kind="raise", at_calls=(4,),
+                                       exc="transient"),
+        "bridge.write_back.torn": FaultSpec(kind="corrupt", at_calls=(2,),
+                                            corruption="truncate"),
+    })
+    with plan.active():
+        for _ in range(K_EPOCHS):
+            eng.step_epoch()
+        eng.materialize()
+
+    # every site actually exercised its seam (schedule sanity)
+    assert plan.fired_sites() == {
+        "engine.dispatch", "engine.aux_readout", "engine.host_copy",
+        "bridge.write_back", "bridge.write_back.torn",
+    }, plan.events
+    assert bytes(hash_tree_root(st)) == oracle
+
+
+def test_chaos_convergence_scan_path(spec):
+    """The lax.scan segment runner under dispatch + readout faults: same
+    oracle root."""
+    oracle = _oracle_root(spec, seed=11)
+    st = prepared_epoch_state(spec, start_epoch=6, seed=11)
+    eng = ResidentEpochEngine(spec, st)
+    eng.retry_policy = FAST_RETRY
+    plan = FaultPlan(seed=77, sites={
+        "engine.dispatch": FaultSpec(kind="raise", at_calls=(1, 3),
+                                     exc="transient"),
+        "engine.aux_readout": FaultSpec(kind="corrupt", at_calls=(2,),
+                                        corruption="truncate"),
+    })
+    with plan.active():
+        eng.run_epochs(K_EPOCHS)
+        eng.materialize()
+    assert plan.fired_sites() == {"engine.dispatch", "engine.aux_readout"}
+    assert bytes(hash_tree_root(st)) == oracle
+
+
+def test_kill_mid_write_back_checkpoint_restore(spec):
+    """A FATAL fault during write-back staging aborts materialize() with
+    the host state untouched (two-phase write-back); restoring the epoch-4
+    checkpoint and re-running reaches the fault-free 6-epoch root."""
+    oracle6 = _oracle_root(spec, seed=23, k=6)
+
+    st = prepared_epoch_state(spec, start_epoch=6, seed=23)
+    eng = ResidentEpochEngine(spec, st)
+    eng.retry_policy = FAST_RETRY
+    for _ in range(4):
+        eng.step_epoch()
+    ck = EngineCheckpoint.capture(eng)
+    for _ in range(2):
+        eng.step_epoch()
+
+    # service the deferred epilogues NOW: they legitimately touch the host
+    # state (slot mirror, vote resets), and the two-phase claim under test
+    # is about the write-back specifically
+    eng._flush_pending()
+    host_root_before = bytes(hash_tree_root(st))
+    plan = FaultPlan(seed=1, sites={
+        "bridge.write_back": FaultSpec(kind="raise", at_calls=(3,),
+                                       exc="fatal"),
+    })
+    with plan.active():
+        with pytest.raises(FatalFault):
+            eng.materialize()
+    assert plan.fires("bridge.write_back") == 1
+    # staging died on the 3rd column, but phase 2 never ran: the host SSZ
+    # tree is bit-for-bit what it was before the attempt
+    assert bytes(hash_tree_root(st)) == host_root_before
+
+    # recovery: restore the checkpoint, replay the lost epochs, converge
+    eng2 = ck.restore(spec)
+    eng2.retry_policy = FAST_RETRY
+    for _ in range(2):
+        eng2.step_epoch()
+    eng2.materialize()
+    assert bytes(hash_tree_root(eng2.state)) == oracle6
+    assert eng2.state_root() == oracle6
+
+
+def test_breaker_degrades_to_python_and_rearms(spec):
+    """Device path hard-down: every epoch degrades to spec.process_epoch,
+    the breaker opens at its threshold, open epochs cost a single probe,
+    and the first fault-free epoch re-arms the device path."""
+    seq = prepared_epoch_state(spec, start_epoch=6, seed=41)
+    oracle = seq.copy()
+
+    brk = CircuitBreaker(failure_threshold=2, name="chaos-test")
+    plan = FaultPlan(seed=2, sites={
+        "bridge.dispatch": FaultSpec(kind="raise", rate=1.0, exc="transient"),
+    })
+    per_epoch = []
+    with plan.active():
+        for _ in range(4):
+            stats = {}
+            bridge.apply_epoch_via_engine(spec, seq, stats=stats, breaker=brk)
+            seq.slot += spec.SLOTS_PER_EPOCH
+            per_epoch.append(stats)
+
+    assert all(s.get("degraded") for s in per_epoch), per_epoch
+    assert brk.state == "open"
+    assert brk.degraded_epochs == 4
+    # epochs 1-2 burn the full retry budget; 3-4 are single half-open probes
+    from consensus_specs_tpu.robustness.retry import DEVICE_POLICY
+
+    assert plan.calls("bridge.dispatch") == 2 * DEVICE_POLICY.max_attempts + 2
+    probe_events = [e for e in brk.events if e["event"] == "half_open_probe"]
+    assert len(probe_events) == 2
+
+    # the degraded epochs are REAL epochs: identical to the pure spec path
+    for _ in range(4):
+        oracle_stats = {}
+        oracle_brk = CircuitBreaker()
+        # no plan installed here -> clean device epochs on the oracle copy
+        bridge.apply_epoch_via_engine(spec, oracle, stats=oracle_stats,
+                                      breaker=oracle_brk)
+        assert "degraded" not in oracle_stats
+        oracle.slot += spec.SLOTS_PER_EPOCH
+    assert bytes(hash_tree_root(seq)) == bytes(hash_tree_root(oracle))
+
+    # fault gone: the next attempt is a successful probe that re-arms
+    stats = {}
+    bridge.apply_epoch_via_engine(spec, seq, stats=stats, breaker=brk)
+    seq.slot += spec.SLOTS_PER_EPOCH
+    assert "degraded" not in stats
+    assert brk.state == "closed"
+    assert brk.events[-1]["event"] == "rearmed"
+    # and the re-armed epoch matches the oracle continuing on device
+    bridge.apply_epoch_via_engine(spec, oracle)
+    oracle.slot += spec.SLOTS_PER_EPOCH
+    assert bytes(hash_tree_root(seq)) == bytes(hash_tree_root(oracle))
+
+
+@pytest.mark.slow
+def test_chaos_soak_randomized_schedule(spec):
+    """Rate-based soak: every seam at a fixed-seed random rate over a
+    longer run. The seed + max_fires caps are chosen so no single seam can
+    deterministically exhaust a 4-attempt budget; the invariant is the
+    same bit-identical convergence."""
+    k = 17
+    oracle = _oracle_root(spec, seed=51, k=k)
+    st = prepared_epoch_state(spec, start_epoch=6, seed=51)
+    eng = ResidentEpochEngine(spec, st)
+    eng.retry_policy = FAST_RETRY
+    plan = FaultPlan(seed=0xDEAD, sites={
+        "engine.dispatch": FaultSpec(kind="raise", rate=0.25, max_fires=2,
+                                     exc="xla"),
+        "engine.aux_readout": FaultSpec(kind="corrupt", rate=0.05,
+                                        max_fires=2, corruption="nan"),
+        "engine.host_copy": FaultSpec(kind="raise", rate=0.3, exc="transient"),
+        "bridge.write_back": FaultSpec(kind="raise", rate=0.2, max_fires=1,
+                                       exc="transient"),
+        "bridge.write_back.torn": FaultSpec(kind="corrupt", rate=0.1,
+                                            max_fires=2,
+                                            corruption="truncate"),
+    })
+    with plan.active():
+        for _ in range(k):
+            eng.step_epoch()
+        eng.materialize()
+    assert bytes(hash_tree_root(st)) == oracle
+    assert len(plan.events) > 0
+
+
+def test_chaos_aux_corruption_is_validated_not_consumed(spec):
+    """A corrupted aux readout that SURVIVED injection would silently skip
+    epilogues (wrong flags); assert the validator actually rejects every
+    corruption kind instead of letting one through."""
+    from consensus_specs_tpu.robustness.faults import CorruptAuxError
+
+    st = prepared_epoch_state(spec, start_epoch=6, seed=13)
+    eng = ResidentEpochEngine(spec, st)
+    # single-attempt policy: the injected corruption must surface, proving
+    # the validation (not luck) is what protects the epilogues
+    eng.retry_policy = RetryPolicy(max_attempts=1)
+    for corruption in ("nan", "truncate"):
+        plan = FaultPlan(seed=3, sites={
+            "engine.aux_readout": FaultSpec(kind="corrupt", at_calls=(1,),
+                                            corruption=corruption),
+        })
+        with plan.active():
+            with pytest.raises(CorruptAuxError):
+                eng.step_epoch()
+                eng._flush_pending()
+        eng._pending = None  # discard the poisoned segment for the next round
+        eng._deferred_epochs = 0

@@ -108,6 +108,144 @@ def test_process_cluster_convergence():
     assert len(digests) == 1, "processes hold different message sets"
 
 
+def _raw_client_node(port):
+    """A listening node plus one raw dialed-in socket (no GossipNode on the
+    sending side, so tests can put arbitrary bytes on the wire)."""
+    import socket
+
+    node = GossipNode(0, port, [])
+    acceptor = threading.Thread(target=node.accept_peers, args=(1,), daemon=True)
+    acceptor.start()
+    client = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    acceptor.join(timeout=10.0)
+    return node, client
+
+
+def _wait_for(cond, timeout=5.0):
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+def test_recv_frame_rejects_oversized_length():
+    """A declared length beyond the wire bound raises FrameError instead of
+    buffering gigabytes from a hostile peer."""
+    import socket
+    import struct
+
+    import pytest
+
+    from consensus_specs_tpu.parallel.gossip_driver import (
+        MAX_WIRE_FRAME,
+        FrameError,
+        recv_frame,
+        send_frame,
+    )
+
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<I", MAX_WIRE_FRAME + 1))
+        with pytest.raises(FrameError):
+            recv_frame(b)
+        # a conforming frame on a fresh pair still round-trips
+        send_frame(a, b"ok")
+        assert recv_frame(b) == b"ok"
+        # and the bound is parameterizable for tighter callers
+        send_frame(a, b"x" * 64)
+        with pytest.raises(FrameError):
+            recv_frame(b, max_frame=16)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rx_quarantines_garbage_snappy_keeps_link():
+    """A well-framed but undecodable payload is counted + quarantined; the
+    SAME connection keeps delivering (the stream is still in sync)."""
+    from consensus_specs_tpu.parallel.gossip_driver import send_frame
+
+    node, client = _raw_client_node(BASE_PORT + 60)
+    try:
+        send_frame(client, b"\xff definitely not snappy")
+        good = encode_message(b"legit attestation payload")
+        send_frame(client, good)
+        assert _wait_for(lambda: node.stats.received == 1)
+        assert node.stats.malformed == 1
+        reason, head = node.stats.quarantined[0]
+        assert reason.startswith("decode:")
+        assert head.startswith(b"\xff")
+        assert node.inbox == [b"legit attestation payload"]
+    finally:
+        client.close()
+        node.close()
+
+
+def test_rx_drops_link_on_oversized_frame():
+    """An oversized declared length poisons the framing: the node must
+    quarantine AND drop that link, and stay healthy for new connections."""
+    import struct
+
+    from consensus_specs_tpu.parallel.gossip_driver import send_frame
+
+    node, client = _raw_client_node(BASE_PORT + 61)
+    try:
+        client.sendall(struct.pack("<I", 1 << 31))
+        assert _wait_for(lambda: node.stats.malformed == 1)
+        assert node.stats.quarantined[0][0].startswith("frame:")
+        # link is dead: frames sent after the violation never arrive
+        try:
+            send_frame(client, encode_message(b"after the violation"))
+        except OSError:
+            pass  # rx side may already have closed the socket
+        # ...but the node still accepts and serves a NEW connection
+        import socket as _socket
+
+        acceptor = threading.Thread(target=node.accept_peers, args=(1,),
+                                    daemon=True)
+        acceptor.start()
+        fresh = _socket.create_connection(("127.0.0.1", BASE_PORT + 61),
+                                          timeout=10.0)
+        acceptor.join(timeout=10.0)
+        try:
+            send_frame(fresh, encode_message(b"fresh link payload"))
+            assert _wait_for(lambda: node.stats.received == 1)
+            assert node.inbox == [b"fresh link payload"]
+        finally:
+            fresh.close()
+    finally:
+        client.close()
+        node.close()
+
+
+def test_fault_injected_frame_truncation_is_quarantined():
+    """The gossip.recv_frame fault seam: an injected truncation on the first
+    frame is absorbed as a quarantine; the untouched second frame lands."""
+    from consensus_specs_tpu.parallel.gossip_driver import send_frame
+    from consensus_specs_tpu.robustness.faults import FaultPlan, FaultSpec
+
+    node, client = _raw_client_node(BASE_PORT + 62)
+    plan = FaultPlan(seed=3, sites={
+        "gossip.recv_frame": FaultSpec(kind="mangle", at_calls=(1,),
+                                       corruption="truncate"),
+    })
+    try:
+        with plan.active():
+            send_frame(client, encode_message(b"first (will be truncated)"))
+            assert _wait_for(lambda: node.stats.malformed == 1)
+            send_frame(client, encode_message(b"second survives"))
+            assert _wait_for(lambda: node.stats.received == 1)
+        assert node.inbox == [b"second survives"]
+        assert plan.fires("gossip.recv_frame") == 1
+    finally:
+        client.close()
+        node.close()
+
+
 def test_message_id_v2_is_topic_bound():
     """Altair message-id (specs/altair/p2p-interface.md): same payload on
     two topics -> distinct ids; phase0 and altair derivations differ even

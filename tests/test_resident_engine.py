@@ -9,8 +9,6 @@ SSZ-hash-identical states — including across eth1-reset, historical-append
 and sync-committee-rotation boundaries, whose epilogues the resident
 engine services from device-current data.
 """
-import random
-
 import pytest
 
 from consensus_specs_tpu.compiler import get_spec
@@ -18,8 +16,6 @@ from consensus_specs_tpu.crypto import bls
 from consensus_specs_tpu.engine import bridge
 from consensus_specs_tpu.engine.resident import ResidentEpochEngine
 from consensus_specs_tpu.ssz import hash_tree_root
-from consensus_specs_tpu.testlib.genesis import create_valid_beacon_state
-from consensus_specs_tpu.testlib.state import transition_to
 
 
 @pytest.fixture(scope="module")
@@ -28,23 +24,10 @@ def spec():
 
 
 def _prepared_state(spec, start_epoch: int, seed: int):
-    state = create_valid_beacon_state(spec)
-    transition_to(spec, state, spec.SlotNumber(start_epoch * spec.SLOTS_PER_EPOCH)
-                  if hasattr(spec, "SlotNumber") else start_epoch * spec.SLOTS_PER_EPOCH)
-    # land on the last slot of start_epoch: the slot process_epoch runs at
-    state.slot = spec.Slot((start_epoch + 1) * spec.SLOTS_PER_EPOCH - 1)
-    rng = random.Random(seed)
-    for i in range(len(state.validators)):
-        state.balances[i] = spec.Gwei(rng.randrange(16_000_000_000, 40_000_000_000))
-        state.previous_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
-        state.current_epoch_participation[i] = spec.ParticipationFlags(rng.randrange(0, 8))
-        state.inactivity_scores[i] = spec.uint64(rng.randrange(0, 100))
-    cur = spec.get_current_epoch(state)
-    state.finalized_checkpoint = spec.Checkpoint(
-        epoch=spec.Epoch(max(0, int(cur) - 2)), root=state.finalized_checkpoint.root)
-    state.current_justified_checkpoint = spec.Checkpoint(
-        epoch=spec.Epoch(max(0, int(cur) - 1)), root=state.current_justified_checkpoint.root)
-    return state
+    # shared with test_robustness / test_chaos_epoch via testlib
+    from consensus_specs_tpu.testlib.state import prepared_epoch_state
+
+    return prepared_epoch_state(spec, start_epoch, seed)
 
 
 @pytest.mark.parametrize("k_epochs", [3, 9])

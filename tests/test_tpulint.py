@@ -92,7 +92,8 @@ def test_layering_fixture():
     assert "das.py" in by_file  # transitive chain through ops/fr_jax
     assert "badop.py" in by_file  # ops/ -> engine/
     assert "prod.py" in by_file  # non-test -> testlib/
-    for clean in ("kzg_shim.py", "codec.py", "scenario.py"):
+    assert "bad_faults.py" in by_file  # robustness/ module-level jax
+    for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py"):
         assert clean not in by_file
 
 

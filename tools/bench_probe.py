@@ -18,12 +18,18 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 import bench  # noqa: E402  (needs REPO_ROOT on sys.path)
+from consensus_specs_tpu.robustness import retry as rretry  # noqa: E402
+
+
+class ProbeUnavailable(Exception):
+    """No usable accelerator answered this probe attempt."""
+
+    retryable = True  # robustness.retry classification marker
 
 # bench_quick's shape overrides (Makefile bench_quick target) — one source
 # of truth would be nicer, but make cannot export to a sibling target and
@@ -99,14 +105,14 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     max_tries = 1 if args.once else args.max_tries
 
-    attempt = 0
-    while True:
-        attempt += 1
+    state = {"attempt": 0}
+
+    def probe_once() -> str:
+        state["attempt"] += 1
+        attempt = state["attempt"]
         platform = bench.probe_accelerator()
         if platform and (platform != "cpu" or args.accept_cpu):
-            print(f"# probe attempt {attempt}: {platform} answered — "
-                  f"running bench_quick lane", file=sys.stderr)
-            return run_bench_quick()
+            return platform
         reason = "no backend" if platform is None else f"platform={platform}"
         print(f"# probe attempt {attempt}: {reason}", file=sys.stderr)
         bench.persist_local({
@@ -116,10 +122,23 @@ def main(argv: list[str] | None = None) -> int:
             "error": f"probe_failed:{reason}",
             "extra": {"attempt": attempt, "max_tries": max_tries},
         })
-        if max_tries and attempt >= max_tries:
-            print(f"# giving up after {attempt} probe attempt(s)", file=sys.stderr)
-            return 2
-        time.sleep(args.interval)
+        raise ProbeUnavailable(reason)
+
+    # The shared retry helper replaces the hand-rolled while/sleep loop:
+    # flat backoff (backoff=1.0, no jitter) keeps the historical fixed
+    # --interval cadence, max_attempts=0 preserves "--max-tries 0 = forever".
+    policy = rretry.RetryPolicy(
+        max_attempts=max_tries, base_delay=args.interval, backoff=1.0,
+        max_delay=args.interval, jitter=0.0)
+    try:
+        platform = rretry.call_with_retry(probe_once, policy)
+    except ProbeUnavailable:
+        print(f"# giving up after {state['attempt']} probe attempt(s)",
+              file=sys.stderr)
+        return 2
+    print(f"# probe attempt {state['attempt']}: {platform} answered — "
+          f"running bench_quick lane", file=sys.stderr)
+    return run_bench_quick()
 
 
 if __name__ == "__main__":
