@@ -40,9 +40,15 @@ testfast:
 # tolerance"). Deterministic schedules only; the long randomized soak is
 # marked `slow` and runs in testall/citest. Hard wall-clock bound so a
 # retry/backoff regression hangs the lane loudly instead of silently.
+# The run writes the canonical obs snapshot (every fault/retry/breaker
+# counter the chaos schedules ticked) to test-results/ and validates it —
+# CI uploads it as the chaos lane's observability artifact.
 chaos:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_chaos.json OBS_SNAPSHOT_LANE=chaos \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_chaos_epoch.py tests/test_robustness.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_chaos.json
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

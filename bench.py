@@ -179,8 +179,20 @@ def run_benches() -> dict:
 
     import jax
 
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+    from consensus_specs_tpu.obs import recompile as obs_recompile
+    from consensus_specs_tpu.obs import trace as obs_trace
     from consensus_specs_tpu.utils.profiling import timed, timings, trace
 
+    # Observability ON for the bench run: spans over every instrumented seam
+    # plus the per-kernel recompile tracker, all feeding the process
+    # registry. The snapshot is persisted next to BENCH_LOCAL.json
+    # (persist_local) and a compact digest rides in extra["obs"] — a bench
+    # record that recompiled a kernel 14 times says so.
+    tracer = obs_trace.Tracer(registry=obs_metrics.REGISTRY,
+                              max_spans=65536).install()
+    compile_tracker = obs_recompile.CompileTracker(
+        registry=obs_metrics.REGISTRY).install()
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     ctx = trace(profile_dir) if profile_dir else contextlib.nullcontext()
     with ctx:
@@ -211,6 +223,18 @@ def run_benches() -> dict:
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
+    tracer.uninstall()
+    compile_tracker.uninstall()
+    obs_digest = {
+        "spans": len(tracer.finished) + tracer.dropped,
+        "spans_dropped": tracer.dropped,
+        "compile_total": compile_tracker.kernels(),
+        "compile_distinct_shapes": {
+            k: compile_tracker.distinct_shapes(k)
+            for k in compile_tracker.kernels()},
+        "flushes": obs_metrics.REGISTRY.counters_matching("bls_flush_total"),
+    }
+    print(f"# obs: {obs_digest}", file=sys.stderr)
     return {
         "metric": "bls_verify_throughput",
         "value": round(vps, 1),
@@ -272,6 +296,9 @@ def run_benches() -> dict:
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],
             "state_root_cold_s": sr["cold_root_s"],
+            # trace/recompile digest; the full canonical snapshot is
+            # BENCH_OBS.json (persist_local), validated by bench_probe
+            "obs": obs_digest,
             "device": str(jax.devices()[0]),
         },
     }
@@ -308,6 +335,19 @@ def persist_local(record: dict) -> None:
             json.dump(history, f, indent=1)
     except Exception as exc:  # never let provenance writing kill the bench
         print(f"# BENCH_LOCAL.json write failed: {exc}", file=sys.stderr)
+    try:
+        # The full canonical obs snapshot rides alongside the scoreboard
+        # history: every counter/histogram the instrumented seams recorded
+        # during this run, in the byte-stable exporter format.
+        # tools/bench_probe.py FAILS (rc 3) when a successful bench leaves
+        # this missing or non-canonical.
+        from consensus_specs_tpu.obs import export as obs_export
+
+        obs_export.write_snapshot(
+            os.path.join(os.path.dirname(path), "BENCH_OBS.json"),
+            meta={"lane": "bench", "git_sha": entry["git_sha"]})
+    except Exception as exc:
+        print(f"# BENCH_OBS.json write failed: {exc}", file=sys.stderr)
 
 
 def main() -> None:

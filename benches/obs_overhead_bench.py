@@ -1,0 +1,77 @@
+"""Disabled-mode observability overhead, MEASURED (ISSUE 6 acceptance).
+
+The obs layer's contract is that an uninstalled tracer costs a few dict
+lookups per span — production seams instrument unconditionally, so the
+disabled path IS the hot path. This bench pins that cost in nanoseconds:
+
+  * disabled `span()` enter/exit (the seam pattern), bare and with attrs;
+  * disabled `annotate()` (the fault/retry deep-seam pattern);
+  * a registry counter inc via cached handle and via registry lookup
+    (both always-on: faults/retry/breaker tick them regardless of tracing);
+  * enabled `span()` enter/exit for contrast (ring append + histogram).
+
+The macro claim — < 2% on benches/epoch_e2e_bench.py with tracing disabled
+versus the pre-instrumentation tree — is a committed before/after
+measurement in BASELINE.md; this bench supplies the per-op numbers that
+bound it (spans-per-epoch x ns-per-span << epoch wall clock).
+
+Usage: python benches/obs_overhead_bench.py — one JSON line.
+"""
+import json
+import sys
+import timeit
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from consensus_specs_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensus_specs_tpu.obs import trace as obs_trace  # noqa: E402
+
+NUMBER = 200_000
+REPEAT = 5
+
+
+def ns_per_op(stmt, setup="pass", number=NUMBER):
+    glb = {"trace": obs_trace, "metrics": obs_metrics}
+    best = min(timeit.repeat(stmt, setup=setup, repeat=REPEAT, number=number,
+                             globals=glb))
+    return best / number * 1e9
+
+
+def run() -> dict:
+    assert obs_trace.current_tracer() is None, "bench must start disabled"
+    out = {}
+    out["noop_baseline_ns"] = round(ns_per_op(
+        "f()", setup="f = lambda: None"), 1)
+    out["disabled_span_ns"] = round(ns_per_op(
+        "\nwith trace.span('engine.dispatch'):\n    pass"), 1)
+    out["disabled_span_attrs_ns"] = round(ns_per_op(
+        "\nwith trace.span('engine.dispatch', epoch=3, k=9):\n    pass"), 1)
+    out["disabled_annotate_ns"] = round(ns_per_op(
+        "trace.annotate(fault_sites='engine.dispatch')"), 1)
+    out["counter_inc_cached_ns"] = round(ns_per_op(
+        "c.inc()",
+        setup="c = metrics.MetricsRegistry().counter('x', site='s')"), 1)
+    out["counter_inc_lookup_ns"] = round(ns_per_op(
+        "r.counter('x', site='s').inc()",
+        setup="r = metrics.MetricsRegistry()"), 1)
+
+    tracer = obs_trace.Tracer(registry=obs_metrics.MetricsRegistry(),
+                              max_spans=1024).install()
+    try:
+        out["enabled_span_ns"] = round(ns_per_op(
+            "\nwith trace.span('engine.dispatch'):\n    pass",
+            number=NUMBER // 10), 1)
+    finally:
+        tracer.uninstall()
+    out["disabled_vs_noop_x"] = round(
+        out["disabled_span_ns"] / max(out["noop_baseline_ns"], 0.1), 1)
+    return out
+
+
+def main():
+    print(json.dumps({"metric": "obs_overhead", "unit": "ns/op", **run()}))
+
+
+if __name__ == "__main__":
+    main()

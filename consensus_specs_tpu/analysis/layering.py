@@ -4,9 +4,11 @@ Three families of constraints, configured as root-agnostic path patterns so
 the same rule runs over `consensus_specs_tpu/` and the fixture mini-packages:
 
   * jax-free py-branches: `evm/`, the crypto host path (`crypto/bls.py`,
-    `crypto/kzg.py`, `crypto/kzg_shim.py`, `crypto/das.py`), and the fault
-    tolerance layer (`robustness/` — consumed by those same host modules, so
-    it inherits their constraint) must be importable with jax unimportable —
+    `crypto/kzg.py`, `crypto/kzg_shim.py`, `crypto/das.py`), the fault
+    tolerance layer (`robustness/`), and the observability layer (`obs/` —
+    consumed by those same host modules, so it inherits their constraint;
+    device hooks live behind obs/recompile.install()) must be importable
+    with jax unimportable —
     no module-level `jax`/`bls_jax` import, direct OR transitive through
     package-internal module-level imports (the PR-3 deferred-import
     discipline; the poisoned-module subprocess tests are the runtime twin of
@@ -33,7 +35,7 @@ class LayeringConfig:
     # path patterns (see core.path_matches) that must stay jax-free at import
     jax_free: tuple[str, ...] = (
         "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
-        "crypto/das.py", "robustness/",
+        "crypto/das.py", "robustness/", "obs/",
     )
     # (importer pattern, forbidden import pattern) over module paths
     forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)

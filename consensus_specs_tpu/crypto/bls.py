@@ -30,6 +30,7 @@ from . import bls_sig as _py
 # (False until crypto/isogeny.py lands: signatures are internally consistent
 # but not RFC-9380-interoperable; see crypto/hash_to_curve.py docstring).
 from .hash_to_curve import MAP_TO_CURVE_RFC_COMPLIANT  # noqa: F401
+from ..obs import trace as _obs_trace
 from ..robustness import faults as _faults
 from ..robustness import retry as _retry
 
@@ -157,8 +158,9 @@ FLUSH_RETRY_POLICY = _retry.RetryPolicy(
 
 
 def _flush_retrying(queue):
-    return _retry.call_with_retry(
-        lambda: _flush_deferred(queue), FLUSH_RETRY_POLICY)
+    with _obs_trace.span("bls.deferred_flush", queued=len(queue)):
+        return _retry.call_with_retry(
+            lambda: _flush_deferred(queue), FLUSH_RETRY_POLICY)
 
 
 def _flush_deferred(queue):

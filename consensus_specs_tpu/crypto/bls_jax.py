@@ -17,10 +17,13 @@ exponentiation per item.
 from __future__ import annotations
 
 import os
+from collections.abc import Mapping
 from functools import lru_cache
 
 import numpy as np
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from . import bls12_381 as oracle
 from .hash_to_curve import hash_to_curve_g2 as _hash_to_curve_g2_uncached
 from .bls12_381 import g2_from_bytes as _g2_from_bytes_uncached
@@ -226,10 +229,68 @@ def _pack_pairing_args(p1s, q1s, p2s, q2s):
     return b, (qx, qy, px, py, q2x, q2y, p2x, p2y)
 
 
-# Observability for the most recent randomized flush (single-threaded bench
-# and test consumption only): which kernel path ran, the padded item/distinct
-# counts, and the Miller-loop bill it implies.
-LAST_FLUSH: dict = {}
+# Observability for the most recent randomized flush: which kernel path ran,
+# the padded item/distinct counts, and the Miller-loop bill it implies. The
+# source of truth is the metrics registry (record_flush below feeds gauges +
+# per-path counters); LAST_FLUSH remains as a read-only Mapping VIEW over
+# those series so existing consumers (benches/bls_verify_bench.py,
+# tests/test_rlc_grouped.py) keep indexing it like the dict it used to be.
+
+_FLUSH_PATHS = ("rlc", "rlc_grouped")
+
+
+def record_flush(path: str, items: int, distinct: int,
+                 miller_loops: int) -> None:
+    """Publish one flush's routing decision to the metrics registry."""
+    reg = _obs_metrics.REGISTRY
+    reg.counter("bls_flush_total", path=path).inc()
+    reg.counter("bls_flush_items_total", path=path).inc(items)
+    reg.counter("bls_flush_miller_loops_total", path=path).inc(miller_loops)
+    reg.gauge("bls_last_flush_items").set(int(items))
+    reg.gauge("bls_last_flush_distinct").set(int(distinct))
+    reg.gauge("bls_last_flush_miller_loops").set(int(miller_loops))
+    for p in _FLUSH_PATHS:
+        reg.gauge("bls_last_flush_path", path=p).set(1 if p == path else 0)
+    _obs_trace.annotate(flush_path=path, flush_items=int(items),
+                        flush_miller_loops=int(miller_loops))
+
+
+class _LastFlushView(Mapping):
+    """Dict-shaped read view of the last flush, backed by the registry.
+
+    Empty before any flush (like the dict it replaces after .clear());
+    supports the full Mapping protocol so `view["path"]`, `view.get(...)`
+    and `dict(view)` behave exactly as before the migration."""
+
+    def _data(self) -> dict:
+        reg = _obs_metrics.REGISTRY
+        path = None
+        for p in _FLUSH_PATHS:
+            if reg.gauge_value("bls_last_flush_path", path=p) == 1:
+                path = p
+        if path is None:
+            return {}
+        return {
+            "path": path,
+            "items": int(reg.gauge_value("bls_last_flush_items")),
+            "distinct": int(reg.gauge_value("bls_last_flush_distinct")),
+            "miller_loops": int(reg.gauge_value("bls_last_flush_miller_loops")),
+        }
+
+    def __getitem__(self, key):
+        return self._data()[key]
+
+    def __iter__(self):
+        return iter(self._data())
+
+    def __len__(self):
+        return len(self._data())
+
+    def __repr__(self):
+        return f"LAST_FLUSH({self._data()!r})"
+
+
+LAST_FLUSH = _LastFlushView()
 
 
 def _pack_grouped_args(p1s, q1s, q2s):
@@ -312,19 +373,28 @@ def _device_check_all(p1s, q1s, p2s, q2s) -> bool:
     # loudly instead of silently verifying the wrong equation
     assert all(p2 is _NEG_G1 for p2 in p2s), "RLC fast path requires p2 == -G1"
     n = len(p1s)
-    if len(set(q1s)) < n:
-        b_n, b_d, args, seg_ids = _pack_grouped_args(p1s, q1s, q2s)
-        ok = K.pairing_check_rlc(*args, None, None, random_zbits(b_n),
-                                 p2_is_neg_g1=True, seg_ids=seg_ids)
-        LAST_FLUSH.clear()
-        LAST_FLUSH.update(path="rlc_grouped", items=b_n, distinct=b_d,
-                          miller_loops=b_d + 1)
-    else:
-        b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
-        ok = K.pairing_check_rlc(*args, random_zbits(b), p2_is_neg_g1=True)
-        LAST_FLUSH.clear()
-        LAST_FLUSH.update(path="rlc", items=b, distinct=b, miller_loops=b + 1)
-    return bool(np.asarray(jax.device_get(ok)))
+    with _obs_trace.span("bls.flush", checks=n):
+        if len(set(q1s)) < n:
+            with _obs_trace.span("bls.flush.pack", path="rlc_grouped"):
+                b_n, b_d, args, seg_ids = _pack_grouped_args(p1s, q1s, q2s)
+            with _obs_trace.span("bls.flush.ladder", path="rlc_grouped"):
+                z = random_zbits(b_n)
+            with _obs_trace.span("bls.flush.miller", path="rlc_grouped"):
+                ok = K.pairing_check_rlc(*args, None, None, z,
+                                         p2_is_neg_g1=True, seg_ids=seg_ids)
+                result = bool(np.asarray(jax.device_get(ok)))
+            record_flush("rlc_grouped", items=b_n, distinct=b_d,
+                         miller_loops=b_d + 1)
+        else:
+            with _obs_trace.span("bls.flush.pack", path="rlc"):
+                b, args = _pack_pairing_args(p1s, q1s, p2s, q2s)
+            with _obs_trace.span("bls.flush.ladder", path="rlc"):
+                z = random_zbits(b)
+            with _obs_trace.span("bls.flush.miller", path="rlc"):
+                ok = K.pairing_check_rlc(*args, z, p2_is_neg_g1=True)
+                result = bool(np.asarray(jax.device_get(ok)))
+            record_flush("rlc", items=b, distinct=b, miller_loops=b + 1)
+    return result
 
 
 def run_checks(checks) -> np.ndarray:

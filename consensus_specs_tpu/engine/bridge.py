@@ -16,6 +16,8 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from ..robustness import faults as rfaults
 from ..robustness.breaker import CircuitBreaker
 from ..robustness.retry import (
@@ -312,11 +314,13 @@ def _write_back(spec, state, dev: EpochState, pre_cols: dict,
     "clean_cols"} where full_bytes is what a dirty-oblivious materialize
     would have moved for the same columns.
     """
-    staged = call_with_retry(
-        lambda: _stage_write_back(spec, state, dev, pre_cols, pre_mixes,
-                                  dirty, mix_rows),
-        retry_policy or DEVICE_POLICY)
-    return _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
+    with _obs_trace.span("bridge.stage_write_back"):
+        staged = call_with_retry(
+            lambda: _stage_write_back(spec, state, dev, pre_cols, pre_mixes,
+                                      dirty, mix_rows),
+            retry_policy or DEVICE_POLICY)
+    with _obs_trace.span("bridge.commit_write_back"):
+        return _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
 
 
 def install_next_sync_committee(spec, state, active, eff, seed: bytes) -> None:
@@ -407,9 +411,10 @@ def _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
         rfaults.fire("bridge.dispatch")
         return epoch_fn_for(cfg)(dev)
 
-    dev_out, aux = call_with_retry(attempt_dispatch, policy)
-    if stage_timer is not None:
-        jax.block_until_ready(dev_out.balances)
+    with _obs_trace.span("bridge.dispatch"):
+        dev_out, aux = call_with_retry(attempt_dispatch, policy)
+        if stage_timer is not None:
+            jax.block_until_ready(dev_out.balances)
     tick("device")
     if dirty_aware:
         flags = _read_aux_flags(aux, policy)
@@ -421,12 +426,14 @@ def _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
     else:
         dirty = None
         mix_rows = None
-    staged = call_with_retry(
-        lambda: _stage_write_back(spec, state, dev_out, pre_cols, pre_mixes,
-                                  dirty, mix_rows),
-        policy)
+    with _obs_trace.span("bridge.stage_write_back"):
+        staged = call_with_retry(
+            lambda: _stage_write_back(spec, state, dev_out, pre_cols, pre_mixes,
+                                      dirty, mix_rows),
+            policy)
     marker["committed"] = True
-    wb = _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
+    with _obs_trace.span("bridge.commit_write_back"):
+        wb = _commit_write_back(spec, state, staged, pre_cols, pre_mixes)
     if stats is not None:
         stats.update(wb)
     if bool(aux.eth1_votes_reset):
@@ -474,17 +481,20 @@ def apply_epoch_via_engine(spec, state, stage_timer=None, dirty_aware=True,
     mode = brk.on_attempt()
     policy = PROBE_POLICY if mode == "probe" else DEVICE_POLICY
     marker = {"committed": False}
-    try:
-        _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
-                            policy, marker)
-    except Exception as exc:
-        if marker["committed"] or not is_device_failure(exc):
-            raise
-        brk.record_failure()
-        # Degraded epoch: state is unmutated (every failure path above
-        # precedes the commit), so the pure-Python spec path runs clean.
-        spec.process_epoch(state)
-        if stats is not None:
-            stats.update({"degraded": True, "degraded_error": repr(exc)})
-    else:
-        brk.record_success()
+    with _obs_trace.span("bridge.apply_epoch", mode=mode) as osp:
+        try:
+            _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
+                                policy, marker)
+        except Exception as exc:
+            if marker["committed"] or not is_device_failure(exc):
+                raise
+            brk.record_failure()
+            osp.set(degraded=True)
+            _obs_metrics.REGISTRY.counter("epoch_degraded_total").inc()
+            # Degraded epoch: state is unmutated (every failure path above
+            # precedes the commit), so the pure-Python spec path runs clean.
+            spec.process_epoch(state)
+            if stats is not None:
+                stats.update({"degraded": True, "degraded_error": repr(exc)})
+        else:
+            brk.record_success()

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import trace as _obs_trace
 from ..robustness import faults as rfaults
 from ..robustness.retry import DEVICE_POLICY, call_with_retry, is_retryable
 from . import bridge
@@ -92,10 +93,11 @@ def _start_host_copies(aux) -> None:
     performs the same transfer synchronously. Only retryable (transient /
     link-level) errors are swallowed — a host-code bug still raises."""
     try:
-        rfaults.fire("engine.host_copy")
-        for leaf in jax.tree_util.tree_leaves(aux):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        with _obs_trace.span("engine.host_copy"):
+            rfaults.fire("engine.host_copy")
+            for leaf in jax.tree_util.tree_leaves(aux):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
     except Exception as exc:
         if not is_retryable(exc):
             raise
@@ -160,7 +162,8 @@ class ResidentEpochEngine:
             rfaults.fire("engine.dispatch")
             return fn(arg)
 
-        return call_with_retry(attempt, self.retry_policy)
+        with _obs_trace.span("engine.dispatch"):
+            return call_with_retry(attempt, self.retry_policy)
 
     def _read_aux(self, aux):
         """Validated host readout of an EpochAux segment.
@@ -194,7 +197,8 @@ class ResidentEpochEngine:
                     f"{e.shape}/{h.shape}/{s.shape}/{d.shape}")
             return e, h, s, d
 
-        return call_with_retry(attempt, self.retry_policy)
+        with _obs_trace.span("engine.aux_readout"):
+            return call_with_retry(attempt, self.retry_policy)
 
     def step_epoch(self, advance_slots: bool = True) -> None:
         """One epoch transition; host work is O(1) except on period
@@ -314,23 +318,27 @@ class ResidentEpochEngine:
         """
         period = self.cfg.epochs_per_sync_committee_period
         done = 0
-        while done < k:
-            # epochs remaining in the CURRENT period (next_epoch = cur+1
-            # triggers rotation when it hits a multiple of the period);
-            # the slot mirror lags by any still-deferred epochs.
-            cur = (int(self.state.slot) // self.cfg.slots_per_epoch
-                   + self._deferred_epochs)
-            to_boundary = period - 1 - (cur % period) + 1  # epochs incl. the one firing rotation
-            seg = min(k - done, to_boundary)
-            self.dev, auxes = self._dispatch(
-                resident_scan_fn_for(self.cfg, seg), self.dev)
-            _start_host_copies(auxes)
-            self._flush_pending()  # previous segment overlaps this launch
-            self._pending = auxes
-            self._deferred_epochs = seg
-            if seg == to_boundary:
-                self._flush_pending()  # segment rotates: service it now
-            done += seg
+        with _obs_trace.span("engine.run_epochs", k=k) as osp:
+            segments = 0
+            while done < k:
+                # epochs remaining in the CURRENT period (next_epoch = cur+1
+                # triggers rotation when it hits a multiple of the period);
+                # the slot mirror lags by any still-deferred epochs.
+                cur = (int(self.state.slot) // self.cfg.slots_per_epoch
+                       + self._deferred_epochs)
+                to_boundary = period - 1 - (cur % period) + 1  # epochs incl. the one firing rotation
+                seg = min(k - done, to_boundary)
+                self.dev, auxes = self._dispatch(
+                    resident_scan_fn_for(self.cfg, seg), self.dev)
+                _start_host_copies(auxes)
+                self._flush_pending()  # previous segment overlaps this launch
+                self._pending = auxes
+                self._deferred_epochs = seg
+                if seg == to_boundary:
+                    self._flush_pending()  # segment rotates: service it now
+                done += seg
+                segments += 1
+            osp.set(segments=segments)
 
     def _rotate_sync_committees_resident(self) -> None:
         """`process_sync_committee_updates` against device-current data.
@@ -391,20 +399,24 @@ class ResidentEpochEngine:
         # columns (np.asarray in _write_back then completes, not starts,
         # each copy). randao is excluded when row-gathered.
         try:
-            rfaults.fire("engine.host_copy")
-            for name, isdirty in dirty.items():
-                if not isdirty or (name == "randao_mixes" and mix_rows is not None):
-                    continue
-                arr = getattr(self.dev, name)
-                if hasattr(arr, "copy_to_host_async"):
-                    arr.copy_to_host_async()
+            with _obs_trace.span("engine.host_copy"):
+                rfaults.fire("engine.host_copy")
+                for name, isdirty in dirty.items():
+                    if not isdirty or (name == "randao_mixes" and mix_rows is not None):
+                        continue
+                    arr = getattr(self.dev, name)
+                    if hasattr(arr, "copy_to_host_async"):
+                        arr.copy_to_host_async()
         except Exception as exc:
             # staging is a latency optimization; _write_back reads sync
             if not is_retryable(exc):
                 raise
-        stats = bridge._write_back(
-            self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes,
-            dirty=dirty, mix_rows=mix_rows, retry_policy=self.retry_policy)
+        with _obs_trace.span("engine.materialize",
+                             epochs=since) as sp:
+            stats = bridge._write_back(
+                self.spec, self.state, self.dev, self._pre_cols, self._pre_mixes,
+                dirty=dirty, mix_rows=mix_rows, retry_policy=self.retry_policy)
+            sp.set(moved_bytes=stats["moved_bytes"])
         self._dirty[:] = False
         self._epochs_since_sync = 0
         return stats

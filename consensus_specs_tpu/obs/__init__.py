@@ -1,0 +1,59 @@
+"""Unified observability for the resident pipeline.
+
+Three cooperating pieces, all jax-free at module level (device hooks are
+deferred behind install calls — the tpulint import-layering rule enforces
+this):
+
+  obs.metrics    process-wide registry: counters, gauges, fixed-bucket
+                 histograms with p50/p99 readout (`REGISTRY`).
+  obs.trace      span tracer (`span("engine.dispatch")`), disabled unless a
+                 Tracer is installed — the FaultPlan pattern.
+  obs.recompile  per-kernel compile counter via jax's lowering log +
+                 jax.monitoring durations; no-op off-device.
+  obs.export     canonical JSON snapshot + Prometheus text, one value set.
+
+See README "Observability" for the span map and BASELINE.md for what each
+metric watches.
+"""
+from .metrics import REGISTRY, MetricsRegistry, DEFAULT_BUCKETS, series_key
+from .trace import (
+    NULL_SPAN,
+    Tracer,
+    annotate,
+    current_tracer,
+    span,
+)
+from .recompile import BACKEND_COMPILE_EVENT, CompileTracker, current_tracker
+from .export import (
+    canonical_json,
+    json_snapshot,
+    prometheus_text,
+    prometheus_value_set,
+    snapshot_dict,
+    snapshot_value_set,
+    validate_snapshot_text,
+    write_snapshot,
+)
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "series_key",
+    "NULL_SPAN",
+    "Tracer",
+    "annotate",
+    "current_tracer",
+    "span",
+    "BACKEND_COMPILE_EVENT",
+    "CompileTracker",
+    "current_tracker",
+    "canonical_json",
+    "json_snapshot",
+    "prometheus_text",
+    "prometheus_value_set",
+    "snapshot_dict",
+    "snapshot_value_set",
+    "validate_snapshot_text",
+    "write_snapshot",
+]

@@ -33,6 +33,8 @@ import struct
 import threading
 from dataclasses import dataclass, field
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from ..robustness import faults as rfaults
 
 MESSAGE_DOMAIN_INVALID_SNAPPY = b"\x00\x00\x00\x00"
@@ -138,6 +140,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
 
 @dataclass
 class NodeStats:
+    """Per-node gossip accounting. Every increment goes through `count`,
+    which mirrors the tick into the process-wide metrics registry
+    (`gossip_<field>_total{node=...}`) — the registry snapshot is the
+    cross-node view, this dataclass stays the cheap per-node one."""
+
+    node_id: int = -1
     produced: int = 0
     received: int = 0
     duplicates: int = 0
@@ -148,6 +156,11 @@ class NodeStats:
     # attribute a misbehaving peer in a postmortem, bounded memory.
     quarantined: list = field(default_factory=list)
 
+    def count(self, stat: str, n: int = 1) -> None:
+        setattr(self, stat, getattr(self, stat) + n)
+        _obs_metrics.REGISTRY.counter(
+            f"gossip_{stat}_total", node=self.node_id).inc(n)
+
 
 class GossipNode:
     """One gossip participant: a listener plus dial-out links to peers."""
@@ -156,7 +169,7 @@ class GossipNode:
         self.node_id = node_id
         self.listen_port = listen_port
         self.peer_ports = peer_ports
-        self.stats = NodeStats()
+        self.stats = NodeStats(node_id=node_id)
         self.inbox: list[bytes] = []  # decompressed ssz payloads
         self._lock = threading.Lock()
         self._server = socket.create_server(("127.0.0.1", listen_port))
@@ -186,7 +199,7 @@ class GossipNode:
         out of the rx loop (one bad peer must not kill message collection
         for every well-behaved one)."""
         with self._lock:
-            self.stats.malformed += 1
+            self.stats.count("malformed")
             self.stats.quarantined.append((reason, bytes(wire[:64])))
             del self.stats.quarantined[:-32]  # keep the most recent 32
 
@@ -204,23 +217,27 @@ class GossipNode:
                 break
             if wire is None:
                 break
-            wire = rfaults.mangle_bytes("gossip.recv_frame", wire)
-            try:
-                ssz = decode_message(wire)
-            except (ValueError, IndexError) as exc:
-                # truncated/garbled snappy payload: the FRAME was still
-                # length-delimited, so the stream is in sync — quarantine
-                # the message, keep the connection
-                self._quarantine(f"decode: {type(exc).__name__}: {exc}", wire)
-                continue
-            mid = message_id(ssz)
-            with self._lock:
-                if mid in self.stats.message_ids:
-                    self.stats.duplicates += 1
+            with _obs_trace.span("gossip.rx", node=self.node_id,
+                                 wire_bytes=len(wire)):
+                wire = rfaults.mangle_bytes("gossip.recv_frame", wire)
+                try:
+                    with _obs_trace.span("gossip.decode", node=self.node_id):
+                        ssz = decode_message(wire)
+                except (ValueError, IndexError) as exc:
+                    # truncated/garbled snappy payload: the FRAME was still
+                    # length-delimited, so the stream is in sync — quarantine
+                    # the message, keep the connection
+                    self._quarantine(
+                        f"decode: {type(exc).__name__}: {exc}", wire)
                     continue
-                self.stats.message_ids.add(mid)
-                self.stats.received += 1
-                self.inbox.append(ssz)
+                mid = message_id(ssz)
+                with self._lock:
+                    if mid in self.stats.message_ids:
+                        self.stats.count("duplicates")
+                        continue
+                    self.stats.message_ids.add(mid)
+                    self.stats.count("received")
+                    self.inbox.append(ssz)
 
     # -- slot actions ---------------------------------------------------------
 
@@ -232,7 +249,7 @@ class GossipNode:
                 if mid not in self.stats.message_ids:
                     self.stats.message_ids.add(mid)
                     self.inbox.append(ssz)
-                    self.stats.produced += 1
+                    self.stats.count("produced")
         for ssz in ssz_payloads:
             wire = encode_message(ssz)
             for link in self._links:
@@ -246,10 +263,12 @@ class GossipNode:
             batch = list(self.inbox)
             self.inbox.clear()
         if batch:
-            with bls.deferred_verification():
-                for ssz in batch:
-                    verify_fn(ssz)
-            self.stats.verified_batches += 1
+            with _obs_trace.span("gossip.drain_and_verify",
+                                 node=self.node_id, batch=len(batch)):
+                with bls.deferred_verification():
+                    for ssz in batch:
+                        verify_fn(ssz)
+            self.stats.count("verified_batches")
         return len(batch)
 
     def close(self) -> None:

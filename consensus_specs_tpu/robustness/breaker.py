@@ -15,25 +15,62 @@ the degraded steady state COSTS:
               retry budget, while recovery is detected within one epoch.
 
 Every transition and degraded epoch is recorded in `events` — liveness
-under partial failure is only worth having if it is observable.
+under partial failure is only worth having if it is observable. The log is
+a BOUNDED ring (a week-long soak on a dead device would otherwise grow it
+one dict per epoch, forever); overflow is not silent — dropped entries are
+counted on the ring and as `breaker_events_dropped_total` in the metrics
+registry, and every event also ticks `breaker_events_total{event=...}`
+there, so the full history survives in counter form after the ring wraps.
 
 jax-free at module level (tpulint import-layering).
 """
 from __future__ import annotations
 
+from ..obs import metrics as _obs_metrics
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+# Default event-ring capacity: plenty for any test or incident window
+# (an epoch produces at most ~2 events even fully degraded).
+EVENT_RING_SIZE = 256
+
+
+class BoundedEventLog(list):
+    """A list that drops its OLDEST entries past `maxlen`, counting them.
+
+    A plain `list` subclass on purpose: existing consumers compare the log
+    to list literals (`brk.events == []`) and slice it — a deque would
+    break them. Only `append` is bounded; the breaker never inserts any
+    other way."""
+
+    def __init__(self, maxlen: int = EVENT_RING_SIZE):
+        super().__init__()
+        self.maxlen = int(maxlen)
+        self.dropped = 0
+
+    def append(self, item) -> None:
+        super().append(item)
+        overflow = len(self) - self.maxlen
+        if overflow > 0:
+            del self[:overflow]
+            self.dropped += overflow
+
+    def clear(self) -> None:
+        super().clear()
+        self.dropped = 0
+
 
 class CircuitBreaker:
-    def __init__(self, failure_threshold: int = 3, name: str = "device-epoch"):
+    def __init__(self, failure_threshold: int = 3, name: str = "device-epoch",
+                 event_ring_size: int = EVENT_RING_SIZE):
         self.failure_threshold = int(failure_threshold)
         self.name = name
         self.state = CLOSED
         self.consecutive_failures = 0
         self.degraded_epochs = 0
-        self.events: list[dict] = []
+        self.events: BoundedEventLog = BoundedEventLog(event_ring_size)
 
     def on_attempt(self) -> str:
         """Call once per epoch before trying the device path. Returns the
@@ -68,11 +105,18 @@ class CircuitBreaker:
         self.events.clear()
 
     def _log(self, event: str) -> None:
+        before = self.events.dropped
         self.events.append({
             "event": event,
             "state": self.state,
             "consecutive_failures": self.consecutive_failures,
         })
+        reg = _obs_metrics.REGISTRY
+        reg.counter("breaker_events_total",
+                    breaker=self.name, event=event).inc()
+        if self.events.dropped > before:
+            reg.counter("breaker_events_dropped_total",
+                        breaker=self.name).inc(self.events.dropped - before)
 
     def __repr__(self) -> str:  # observability in test failures
         return (f"CircuitBreaker({self.name!r}, state={self.state}, "

@@ -35,6 +35,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+
 FORMAT = "engine-checkpoint-v1"
 
 
@@ -141,6 +144,8 @@ class EngineCheckpoint:
     def verify(self) -> None:
         actual = self.compute_digest()
         if actual != self.digest:
+            _obs_metrics.REGISTRY.counter(
+                "checkpoint_integrity_failures_total").inc()
             raise CheckpointIntegrityError(
                 f"checkpoint digest mismatch: recorded {self.digest[:16]}…, "
                 f"content hashes to {actual[:16]}… — refusing to restore "
@@ -151,6 +156,11 @@ class EngineCheckpoint:
     @classmethod
     def capture(cls, engine) -> "EngineCheckpoint":
         """Snapshot at a clean epoch boundary (deferred service flushed)."""
+        with _obs_trace.span("checkpoint.capture"):
+            return cls._capture(engine)
+
+    @classmethod
+    def _capture(cls, engine) -> "EngineCheckpoint":
         engine._flush_pending()
         dev = {f.name: np.array(getattr(engine.dev, f.name))
                for f in dataclasses.fields(type(engine.dev))}
@@ -175,6 +185,7 @@ class EngineCheckpoint:
             inc=inc,
         )
         ckpt.digest = ckpt.compute_digest()
+        _obs_metrics.REGISTRY.counter("checkpoint_total", op="capture").inc()
         return ckpt
 
     # -- restore --------------------------------------------------------------
@@ -186,7 +197,12 @@ class EngineCheckpoint:
         arrays re-enter through jnp.array (jax-owned copies — the donation
         discipline from bridge.state_to_device_with_columns applies to a
         restore exactly as to a fresh bridge-in)."""
+        with _obs_trace.span("checkpoint.restore"):
+            return self._restore(spec)
+
+    def _restore(self, spec):
         self.verify()
+        _obs_metrics.REGISTRY.counter("checkpoint_total", op="restore").inc()
         fork = str(getattr(spec, "fork", ""))
         if self.meta.get("fork") and fork and self.meta["fork"] != fork:
             raise CheckpointIntegrityError(
@@ -227,6 +243,7 @@ class EngineCheckpoint:
     # -- disk format ----------------------------------------------------------
 
     def save(self, path) -> None:
+        _obs_metrics.REGISTRY.counter("checkpoint_total", op="save").inc()
         arrays: dict = {}
         skel = _flatten(self._payload(), "", arrays)
         manifest = json.dumps({"format": FORMAT, "digest": self.digest,
@@ -264,4 +281,5 @@ class EngineCheckpoint:
         payload = _unflatten(manifest["skeleton"], arrays_by_key)
         ckpt = cls(state_ssz=state_ssz, digest=manifest["digest"], **payload)
         ckpt.verify()
+        _obs_metrics.REGISTRY.counter("checkpoint_total", op="load").inc()
         return ckpt

@@ -29,6 +29,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+
 
 # --- error taxonomy ----------------------------------------------------------
 
@@ -159,6 +162,12 @@ class FaultPlan:
     def _log(self, site: str, ix: int, action: str) -> None:
         with self._lock:
             self.events.append(FaultEvent(site, ix, action))
+        # Observability mirror: every fire is a counter tick (reconciled
+        # 1:1 against plan.fires(site) by the chaos lane) and an attribute
+        # on the innermost active span, so a trace shows WHERE each
+        # injected failure landed, not just that one did.
+        _obs_metrics.REGISTRY.counter("fault_fires_total", site=site).inc()
+        _obs_trace.annotate(fault_sites=site)
 
     def install(self) -> "FaultPlan":
         global _PLAN

@@ -29,6 +29,8 @@ from dataclasses import dataclass
 from random import Random
 from typing import Callable, Optional
 
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from .faults import FaultInjected
 
 # Exception type NAMES that classify as retryable device failures; matching
@@ -106,7 +108,17 @@ def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None, *,
         except Exception as exc:
             exhausted = policy.max_attempts and attempt >= policy.max_attempts
             if exhausted or not classify(exc):
+                if exhausted and classify(exc):
+                    _obs_metrics.REGISTRY.counter(
+                        "retries_exhausted_total",
+                        error=type(exc).__name__).inc()
                 raise
+            # One tick per absorbed failure, labeled by exception type: the
+            # chaos lane reconciles these against the fault plan's per-site
+            # fire counts (each retried fire is caught exactly once here).
+            _obs_metrics.REGISTRY.counter(
+                "retries_total", error=type(exc).__name__).inc()
+            _obs_trace.annotate(retried_errors=type(exc).__name__)
             if on_retry is not None:
                 on_retry(attempt, exc)
             sleep(policy.delay(attempt, rng))

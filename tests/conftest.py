@@ -9,6 +9,7 @@ update) lives in the shared helper consensus_specs_tpu.utils.backend.force_cpu
 — the same path __graft_entry__.dryrun_multichip and bench.py's debug lane
 use, so all TPU-free entry points pin the backend identically.
 """
+import os
 from pathlib import Path
 
 import pytest
@@ -65,3 +66,23 @@ def pytest_configure(config):
     bls_opt = config.getoption("--bls")
     if bls_opt:
         bls.bls_active = bls_opt == "on"
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _obs_snapshot_artifact():
+    """When OBS_SNAPSHOT names a path (the `make chaos` and CI lanes), write
+    the canonical metrics-registry snapshot there at session end — every
+    counter the instrumented seams ticked during the run becomes a diffable
+    artifact. tools/obs_dump.py `check` validates it; silent corruption of
+    the format fails the lane, not a later consumer."""
+    yield
+    path = os.environ.get("OBS_SNAPSHOT")
+    if not path:
+        return
+    from consensus_specs_tpu.obs import export as obs_export
+
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    obs_export.write_snapshot(
+        path, meta={"lane": os.environ.get("OBS_SNAPSHOT_LANE", "pytest")})

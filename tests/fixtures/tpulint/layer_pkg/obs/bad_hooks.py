@@ -1,0 +1,8 @@
+"""Seeded failure shape: an obs module wiring its compile hooks at import
+time — the module-level jax import poisons every jax-free consumer that
+records a metric (crypto/bls.py, robustness/, the gossip driver)."""
+import jax.monitoring  # noqa  tpulint-expect: import-layering
+
+
+def install():
+    jax.monitoring.register_event_listener(lambda e: None)

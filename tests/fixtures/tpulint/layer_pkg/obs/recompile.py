@@ -1,0 +1,15 @@
+"""Clean obs module: jax-free at module level, device hooks deferred into
+install() — the sanctioned pattern for the observability layer (metrics and
+tracing must be importable from every jax-free py-branch)."""
+
+counts = {}
+
+
+def install():
+    import jax.monitoring  # deferred: only an installed tracker needs jax
+
+    jax.monitoring.register_event_listener(lambda e: None)
+
+
+def on_compile(kernel):
+    counts[kernel] = counts.get(kernel, 0) + 1

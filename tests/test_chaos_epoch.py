@@ -232,6 +232,137 @@ def test_breaker_degrades_to_python_and_rearms(spec):
     assert bytes(hash_tree_root(seq)) == bytes(hash_tree_root(oracle))
 
 
+def test_chaos_trace_reconciles_with_fault_plan(spec):
+    """ISSUE 6 acceptance: a seeded chaos run under an installed Tracer
+    produces a trace in which EVERY fired fault site appears as a span
+    attribute, and the fault/retry counters reconcile EXACTLY with the
+    plan's per-site fire counts — injected chaos cannot fire invisibly."""
+    from consensus_specs_tpu.obs import export as obs_export
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+    from consensus_specs_tpu.obs import trace as obs_trace
+
+    st = prepared_epoch_state(spec, start_epoch=6, seed=11)
+    eng = ResidentEpochEngine(spec, st)
+    eng.retry_policy = FAST_RETRY
+    plan = FaultPlan(seed=0xC0FFEE, sites={
+        "engine.dispatch": FaultSpec(kind="raise", at_calls=(2, 5, 6),
+                                     exc="transient"),
+        "engine.aux_readout": FaultSpec(kind="corrupt", at_calls=(3, 14),
+                                        corruption="nan"),
+        "engine.host_copy": FaultSpec(kind="raise", at_calls=(4,),
+                                      exc="transient"),
+        "bridge.write_back": FaultSpec(kind="raise", at_calls=(4,),
+                                       exc="transient"),
+        "bridge.write_back.torn": FaultSpec(kind="corrupt", at_calls=(2,),
+                                            corruption="truncate"),
+    })
+    reg = obs_metrics.REGISTRY
+    fires_before = {s: reg.counter_value("fault_fires_total", site=s)
+                    for s in plan.sites}
+    retries_before = {e: reg.counter_value("retries_total", error=e)
+                      for e in ("TransientFault", "CorruptAuxError",
+                                "TornWriteBackError")}
+    exhausted_before = sum(
+        reg.counters_matching("retries_exhausted_total").values())
+
+    tracer = obs_trace.Tracer(registry=reg).install()
+    try:
+        with plan.active():
+            for _ in range(K_EPOCHS):
+                eng.step_epoch()
+            eng.materialize()
+    finally:
+        tracer.uninstall()
+    assert plan.fired_sites() == set(plan.sites), plan.events
+
+    # 1. every fired site is visible as a span attribute, with multiplicity:
+    #    each fire annotated the innermost span open at injection time
+    attr_fires: dict = {}
+    for sp in tracer.spans():
+        for site in sp["attrs"].get("fault_sites", ()):
+            attr_fires[site] = attr_fires.get(site, 0) + 1
+    assert attr_fires == {s: plan.fires(s) for s in plan.sites}
+
+    # 2. fault counters reconcile exactly with the plan's fire counts
+    for s in plan.sites:
+        delta = reg.counter_value("fault_fires_total", site=s) - fires_before[s]
+        assert delta == plan.fires(s), (s, delta, plan.fires(s))
+
+    # 3. retry counters reconcile: every retried fire was absorbed exactly
+    #    once, labeled by its exception type. engine.host_copy is NOT in the
+    #    retry ledger — its failure degrades to a sync read (visible instead
+    #    as an error-status engine.host_copy span).
+    def retry_delta(error):
+        return reg.counter_value("retries_total", error=error) - retries_before[error]
+
+    assert retry_delta("TransientFault") == (
+        plan.fires("engine.dispatch") + plan.fires("bridge.write_back"))
+    assert retry_delta("CorruptAuxError") == plan.fires("engine.aux_readout")
+    assert retry_delta("TornWriteBackError") == plan.fires("bridge.write_back.torn")
+    assert sum(reg.counters_matching("retries_exhausted_total").values()) \
+        == exhausted_before  # nothing blew its budget
+    degraded = [s for s in tracer.spans("engine.host_copy")
+                if s["status"] == "error"]
+    assert len(degraded) == plan.fires("engine.host_copy")
+    assert degraded[0]["attrs"]["exc"] == "TransientFault"
+
+    # 4. the run's registry state exports canonically (the chaos lane
+    #    artifact is this snapshot)
+    ok, reason = obs_export.validate_snapshot_text(
+        obs_export.json_snapshot(reg, meta={"lane": "chaos"}))
+    assert ok, reason
+
+
+def test_chaos_breaker_counters_reconcile(spec):
+    """Breaker half of the acceptance invariant: the registry's
+    breaker_events_total series reconcile exactly with the breaker's own
+    event history (and with the fault plan driving it)."""
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.REGISTRY
+
+    def event_counts(name):
+        out = {}
+        for k, v in reg.counters_matching("breaker_events_total").items():
+            if f'breaker="{name}"' in k:
+                event = k.split('event="')[1].split('"')[0]
+                out[event] = v
+        return out
+
+    name = "chaos-reconcile"
+    before = event_counts(name)
+    brk = CircuitBreaker(failure_threshold=2, name=name)
+    seq = prepared_epoch_state(spec, start_epoch=6, seed=41)
+    plan = FaultPlan(seed=2, sites={
+        "bridge.dispatch": FaultSpec(kind="raise", rate=1.0, exc="transient"),
+    })
+    with plan.active():
+        for _ in range(3):
+            stats = {}
+            bridge.apply_epoch_via_engine(spec, seq, stats=stats, breaker=brk)
+            seq.slot += spec.SLOTS_PER_EPOCH
+    # fault-free epoch: the half-open probe succeeds and re-arms
+    stats = {}
+    bridge.apply_epoch_via_engine(spec, seq, stats=stats, breaker=brk)
+    assert brk.state == "closed" and "degraded" not in stats
+
+    after = event_counts(name)
+    from_log: dict = {}
+    for e in brk.events:
+        from_log[e["event"]] = from_log.get(e["event"], 0) + 1
+    assert brk.events.dropped == 0  # nothing wrapped: the log IS the history
+    deltas = {k: after.get(k, 0) - before.get(k, 0)
+              for k in set(after) | set(before)}
+    assert {k: v for k, v in deltas.items() if v} == from_log
+    # and the plan ties out: 2 full budgets + 1 probe (epoch 3 open->probe)
+    # + 1 successful probe attempt that did not fire
+    from consensus_specs_tpu.robustness.retry import DEVICE_POLICY
+
+    assert plan.calls("bridge.dispatch") == 2 * DEVICE_POLICY.max_attempts + 1
+    assert from_log["degraded_to_python"] == 3
+    assert from_log["rearmed"] == 1
+
+
 @pytest.mark.slow
 def test_chaos_soak_randomized_schedule(spec):
     """Rate-based soak: every seam at a fixed-seed random rate over a

@@ -256,6 +256,33 @@ def test_breaker_opens_probes_and_rearms():
     assert brk.state == rbreaker.CLOSED and brk.events == []
 
 
+def test_breaker_event_ring_is_bounded_with_drop_counter():
+    """Regression for the unbounded event log: a week-long degraded soak
+    must not grow `events` past the ring size, dropped entries are counted
+    (on the ring AND in the registry), and the full per-event history
+    survives in counter form after the ring wraps."""
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    brk = CircuitBreaker(failure_threshold=2, name="ring-test",
+                         event_ring_size=8)
+    base = obs_metrics.REGISTRY.counter_value(
+        "breaker_events_total", breaker="ring-test", event="degraded_to_python")
+    for _ in range(50):
+        brk.record_failure()  # every one logs degraded_to_python
+    assert len(brk.events) == 8
+    assert brk.events.dropped == 50 + 1 - 8  # +1: the "opened" transition
+    assert obs_metrics.REGISTRY.counter_value(
+        "breaker_events_dropped_total", breaker="ring-test") == brk.events.dropped
+    # counters kept the whole history the ring forgot
+    assert obs_metrics.REGISTRY.counter_value(
+        "breaker_events_total", breaker="ring-test",
+        event="degraded_to_python") - base == 50
+    # the ring still behaves like the list the older tests compare against
+    assert brk.events[-1]["event"] == "degraded_to_python"
+    brk.reset()
+    assert brk.events == [] and brk.events.dropped == 0
+
+
 # --- checkpoints -------------------------------------------------------------
 
 

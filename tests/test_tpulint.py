@@ -93,7 +93,9 @@ def test_layering_fixture():
     assert "badop.py" in by_file  # ops/ -> engine/
     assert "prod.py" in by_file  # non-test -> testlib/
     assert "bad_faults.py" in by_file  # robustness/ module-level jax
-    for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py"):
+    assert "bad_hooks.py" in by_file  # obs/ module-level jax.monitoring
+    for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
+                  "recompile.py"):  # recompile: obs install-deferral pattern
         assert clean not in by_file
 
 

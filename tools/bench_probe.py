@@ -87,6 +87,31 @@ def check_e2e_lane() -> int:
     print(f"# bench-probe: e2e lane present "
           f"(e2e={extra['bls_verify_throughput_e2e']}/s over "
           f"{extra['rlc_distinct_messages']} distinct messages)", file=sys.stderr)
+    return check_obs_snapshot()
+
+
+def check_obs_snapshot() -> int:
+    """A successful bench must leave the canonical obs snapshot next to
+    BENCH_LOCAL.json (bench.persist_local writes it). Missing or
+    non-canonical bytes fail LOUDLY: a bench record without its trace /
+    recompile provenance is the same evidence gap as a kernel number
+    without the e2e lane."""
+    from consensus_specs_tpu.obs import export as obs_export
+
+    path = os.path.join(REPO_ROOT, "BENCH_OBS.json")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as exc:
+        print(f"# bench-probe: FATAL — BENCH_OBS.json missing after a "
+              f"successful bench ({exc})", file=sys.stderr)
+        return 3
+    ok, reason = obs_export.validate_snapshot_text(text)
+    if not ok:
+        print(f"# bench-probe: FATAL — BENCH_OBS.json is not a canonical obs "
+              f"snapshot: {reason}", file=sys.stderr)
+        return 3
+    print("# bench-probe: obs snapshot present and canonical", file=sys.stderr)
     return 0
 
 

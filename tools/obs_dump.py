@@ -1,0 +1,127 @@
+"""Observability snapshot tool (`make obs-dump`, CI artifact checks).
+
+Three subcommands over the canonical JSON snapshot format
+(consensus_specs_tpu/obs/export.py):
+
+  check FILE   validate an on-disk snapshot: parseable, right version,
+               canonical bytes, and Prometheus round-trip (the text
+               exposition's value set must equal the JSON's). Exit 0 ok,
+               1 invalid, 2 unreadable. CI runs this over every uploaded
+               artifact; tools/bench_probe.py runs it over the snapshot
+               persisted next to BENCH_LOCAL.json.
+  prom FILE    render the snapshot as Prometheus text exposition (stdout),
+               for scraping/diffing with standard tooling.
+  table FILE   human-oriented summary: counters and gauges sorted by
+               series key, histograms as count/sum/p50/p99.
+
+`FILE` may be `-` for stdin, so `... | obs_dump.py check -` works in a
+pipeline.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from consensus_specs_tpu.obs import export as obs_export  # noqa: E402
+
+
+def _read(path: str) -> str:
+    if path == "-":
+        return sys.stdin.read()
+    with open(path) as f:
+        return f.read()
+
+
+def cmd_check(path: str) -> int:
+    try:
+        text = _read(path)
+    except OSError as exc:
+        print(f"obs-dump: cannot read {path}: {exc}", file=sys.stderr)
+        return 2
+    ok, reason = obs_export.validate_snapshot_text(text)
+    if not ok:
+        print(f"obs-dump: INVALID snapshot {path}: {reason}", file=sys.stderr)
+        return 1
+    import json
+
+    snap = json.loads(text)
+    json_vals = obs_export.snapshot_value_set(snap)
+    prom_vals = obs_export.prometheus_value_set(obs_export.prometheus_text(snap))
+    if json_vals != prom_vals:
+        only_j = sorted(set(json_vals) - set(prom_vals))[:5]
+        only_p = sorted(set(prom_vals) - set(json_vals))[:5]
+        diff = sorted(k for k in set(json_vals) & set(prom_vals)
+                      if json_vals[k] != prom_vals[k])[:5]
+        print(f"obs-dump: EXPORTER DISAGREEMENT {path}: "
+              f"json-only={only_j} prom-only={only_p} differing={diff}",
+              file=sys.stderr)
+        return 1
+    n = (len(snap.get("counters", {})) + len(snap.get("gauges", {}))
+         + len(snap.get("histograms", {})))
+    print(f"obs-dump: OK {path} ({n} series, version {snap['version']})")
+    return 0
+
+
+def _load(path: str) -> dict:
+    text = _read(path)
+    ok, reason = obs_export.validate_snapshot_text(text)
+    if not ok:
+        raise SystemExit(f"obs-dump: INVALID snapshot {path}: {reason}")
+    import json
+
+    return json.loads(text)
+
+
+def cmd_prom(path: str) -> int:
+    sys.stdout.write(obs_export.prometheus_text(_load(path)))
+    return 0
+
+
+def cmd_table(path: str) -> int:
+    snap = _load(path)
+    rows = []
+    for key, v in sorted(snap.get("counters", {}).items()):
+        rows.append((key, "counter", f"{v:g}"))
+    for key, v in sorted(snap.get("gauges", {}).items()):
+        rows.append((key, "gauge", f"{v:g}"))
+    for key, h in sorted(snap.get("histograms", {}).items()):
+        rows.append((key, "histogram",
+                     f"count={h['count']} sum={h['sum']:.6g} "
+                     f"p50={h['p50']:.6g} p99={h['p99']:.6g}"))
+    if not rows:
+        print("(empty snapshot)")
+        return 0
+    width = max(len(r[0]) for r in rows)
+    for key, kind, val in rows:
+        print(f"{key:<{width}}  {kind:<9}  {val}")
+    if "meta" in snap:
+        print(f"\nmeta: {snap['meta']}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, doc in (("check", "validate canonicality + exporter agreement"),
+                      ("prom", "render Prometheus text exposition"),
+                      ("table", "human-oriented summary")):
+        p = sub.add_parser(name, help=doc)
+        p.add_argument("file", help="snapshot path, or - for stdin")
+    args = parser.parse_args(argv)
+    return {"check": cmd_check, "prom": cmd_prom,
+            "table": cmd_table}[args.cmd](args.file)
+
+
+if __name__ == "__main__":
+    try:
+        rc = main()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        rc = 0
+    raise SystemExit(rc)
