@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding
 
-.PHONY: test testall citest testfast chaos lint pyspec generate_tests \
+.PHONY: test testall citest testfast chaos lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -82,6 +82,14 @@ lint: pyspec
 	$(PYTHON) tools/typegate.py
 	$(PYTHON) tools/tpulint.py consensus_specs_tpu --baseline tpulint_baseline.json
 	$(PYTHON) tools/tpulint.py --self-test
+
+# Inner-loop lint: full interprocedural analysis (the call graph needs every
+# module), but only findings on files changed since $(SINCE) are reported —
+# seconds of signal on the file you are editing, no baseline noise from the
+# rest of the tree. `make lint-fast SINCE=origin/main` before pushing.
+SINCE ?= HEAD
+lint-fast:
+	$(PYTHON) tools/tpulint.py consensus_specs_tpu --since $(SINCE)
 
 # Regenerate the checked-in randomized test module (reference:
 # tests/generators/random/generate.py workflow).

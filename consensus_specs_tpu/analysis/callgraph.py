@@ -1,0 +1,252 @@
+"""Package-wide call graph: the spine of tpulint's interprocedural passes.
+
+The PR-4 rules were per-function AST walks; the invariants PRs 5-6 made
+load-bearing (retries pre-donation, bounded compile-cache pressure,
+span+counter instrumentation on every fault seam) all cross call
+boundaries. This module gives the v2 rules the three things a dataflow
+pass needs and a plain `ast.walk` cannot provide:
+
+  * a def index: every function in the scan, keyed by a stable qualname
+    (`<module>:<outer>.<inner>` — nested defs keep their lexical chain);
+  * resolved call edges: each `ast.Call` mapped to the FuncInfo it invokes,
+    through lexical scoping, `from .x import f` bindings, and module-alias
+    attribute chains (`rfaults.fire` -> `<pkg>.robustness.faults:fire`);
+  * parent links: per-module child->parent maps so rules can ask lexical
+    questions ("is this call inside a `with span(...)`?", "inside a loop?")
+    without re-walking the tree.
+
+Resolution is deliberately conservative: anything ambiguous (getattr
+chains, `self.method`, callables received as arguments) resolves to None
+and downstream rules under-approximate — the same stance the PR-4 rules
+took, now stated once here instead of per rule. Stdlib-ast only, per the
+package charter.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Optional
+
+from .core import Module, dotted
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclass
+class FuncInfo:
+    """One function definition anywhere in the scan."""
+
+    qualname: str  # "<module dotted name>:<def>[.<nested def>...]"
+    module: Module
+    node: ast.AST
+    params: tuple[str, ...]
+    parent: Optional[str] = None  # qualname of the lexically enclosing def
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+
+    @property
+    def top_qualname(self) -> str:
+        """Qualname of the outermost def containing this one (itself when
+        top-level) — the granularity the seam-coverage rule reasons at."""
+        mod, _, path = self.qualname.partition(":")
+        return f"{mod}:{path.split('.')[0]}"
+
+
+@dataclass
+class CallSite:
+    """One `ast.Call`, with enough context to reason interprocedurally."""
+
+    module: Module
+    node: ast.Call
+    caller: Optional[str]  # qualname of the enclosing def; None = module level
+    callee: Optional[str]  # resolved qualname; None = unresolvable
+
+
+# import binding targets: ("mod", dotted) | ("func", module, name) | ("ext", root)
+_Binding = tuple
+
+
+def _param_names(node: ast.AST) -> tuple[str, ...]:
+    a = node.args
+    names = [p.arg for p in (*a.posonlyargs, *a.args)]
+    return tuple(names)
+
+
+def _resolve_relative(mod_name: str, level: int, target: str | None) -> str:
+    parts = mod_name.split(".")
+    base = parts[: len(parts) - level] if level <= len(parts) else []
+    return ".".join(base + (target.split(".") if target else []))
+
+
+class CallGraph:
+    """Built once per analysis run and shared by every interprocedural rule."""
+
+    def __init__(self) -> None:
+        self.functions: dict[str, FuncInfo] = {}
+        self.calls: list[CallSite] = []
+        # id(ast.Call) -> resolved callee qualname (subset of self.calls info,
+        # indexed for rules that walk their own paths through the tree)
+        self.resolved: dict[int, str] = {}
+        # callee qualname -> its call sites
+        self.callers: dict[str, list[CallSite]] = {}
+        # module dotted name -> { id(child node) -> parent node }
+        self.parents: dict[str, dict[int, ast.AST]] = {}
+        # module dotted name -> { local alias -> binding }
+        self.imports: dict[str, dict[str, _Binding]] = {}
+        self._mods: dict[str, Module] = {}
+        self._by_node: dict[int, str] = {}  # id(def node) -> qualname
+
+    # -- construction ----------------------------------------------------------
+
+    @classmethod
+    def build(cls, mods: list[Module]) -> "CallGraph":
+        g = cls()
+        g._mods = {m.name: m for m in mods}
+        names = set(g._mods)
+        for m in mods:
+            g.parents[m.name] = {
+                id(child): parent
+                for parent in ast.walk(m.tree)
+                for child in ast.iter_child_nodes(parent)
+            }
+            g.imports[m.name] = g._collect_imports(m, names)
+            g._index_defs(m)
+        for m in mods:
+            g._resolve_module_calls(m)
+        return g
+
+    def _collect_imports(self, mod: Module, names: set[str]) -> dict[str, _Binding]:
+        def classify(raw: str) -> _Binding:
+            parts = raw.split(".")
+            for i in range(len(parts), 0, -1):
+                cand = ".".join(parts[:i])
+                if cand in names:
+                    if i == len(parts):
+                        return ("mod", cand)
+                    if i == len(parts) - 1:
+                        return ("func", cand, parts[-1])
+                    return ("mod", cand)  # deeper attribute chain: module wins
+            return ("ext", parts[0])
+
+        out: dict[str, _Binding] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    out[local] = classify(alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                base = (_resolve_relative(mod.name, node.level, node.module)
+                        if node.level else (node.module or ""))
+                for alias in node.names:
+                    raw = f"{base}.{alias.name}" if base else alias.name
+                    out[alias.asname or alias.name] = classify(raw)
+        return out
+
+    def _index_defs(self, mod: Module) -> None:
+        def visit(node: ast.AST, stack: list[str], parent_q: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    q = f"{mod.name}:{'.'.join([*stack, child.name])}"
+                    self.functions[q] = FuncInfo(
+                        qualname=q, module=mod, node=child,
+                        params=_param_names(child), parent=parent_q)
+                    self._by_node[id(child)] = q
+                    visit(child, [*stack, child.name], q)
+                elif not isinstance(child, ast.Lambda):
+                    visit(child, stack, parent_q)
+
+        visit(mod.tree, [], None)
+
+    # -- resolution ------------------------------------------------------------
+
+    def _scope_defs(self, mod: Module, body: list) -> dict[str, str]:
+        """def-name -> qualname for defs that are DIRECT statements of `body`
+        (lexical visibility; defs are visible to the whole scope)."""
+        out = {}
+        for stmt in body:
+            if isinstance(stmt, _FUNC_NODES) and id(stmt) in self._by_node:
+                out[stmt.name] = self._by_node[id(stmt)]
+        return out
+
+    def _resolve_module_calls(self, mod: Module) -> None:
+        imports = self.imports[mod.name]
+
+        def resolve(call: ast.Call, scopes: list[dict[str, str]]) -> Optional[str]:
+            func = call.func
+            if isinstance(func, ast.Name):
+                for env in reversed(scopes):
+                    if func.id in env:
+                        return env[func.id]
+                b = imports.get(func.id)
+                if b is not None and b[0] == "func":
+                    q = f"{b[1]}:{b[2]}"
+                    return q if q in self.functions else None
+                return None
+            name = dotted(func)
+            if name is None:
+                return None
+            parts = name.split(".")
+            b = imports.get(parts[0])
+            if b is None or b[0] != "mod":
+                return None
+            # longest module prefix, remainder must be a single function name
+            for i in range(len(parts) - 1, 0, -1):
+                cand_mod = ".".join([b[1], *parts[1:i]]) if i > 1 else b[1]
+                if cand_mod in self._mods:
+                    q = f"{cand_mod}:{parts[i]}" if i == len(parts) - 1 else None
+                    return q if q is not None and q in self.functions else None
+            return None
+
+        def walk(node: ast.AST, scopes: list[dict[str, str]],
+                 caller: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    q = self._by_node.get(id(child), caller)
+                    walk(child, [*scopes, self._scope_defs(mod, child.body)], q)
+                    continue
+                if isinstance(child, ast.Call):
+                    callee = resolve(child, scopes)
+                    site = CallSite(module=mod, node=child,
+                                    caller=caller, callee=callee)
+                    self.calls.append(site)
+                    if callee is not None:
+                        self.resolved[id(child)] = callee
+                        self.callers.setdefault(callee, []).append(site)
+                walk(child, scopes, caller)
+
+        walk(mod.tree, [self._scope_defs(mod, mod.tree.body)], None)
+
+    # -- lexical queries -------------------------------------------------------
+
+    def ancestors(self, mod: Module, node: ast.AST):
+        """Yield lexical ancestors of `node`, innermost first."""
+        parents = self.parents[mod.name]
+        cur = parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = parents.get(id(cur))
+
+    def enclosing_function(self, mod: Module, node: ast.AST) -> Optional[FuncInfo]:
+        for anc in self.ancestors(mod, node):
+            if isinstance(anc, _FUNC_NODES):
+                q = self._by_node.get(id(anc))
+                return self.functions.get(q) if q else None
+        return None
+
+    def in_loop(self, mod: Module, node: ast.AST) -> bool:
+        """True when `node` sits lexically inside a for/while of its own
+        function scope (loops in ENCLOSING functions do not count)."""
+        for anc in self.ancestors(mod, node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(anc, _FUNC_NODES + (ast.Lambda,)):
+                return False
+        return False
+
+    def function_for_node(self, node: ast.AST) -> Optional[FuncInfo]:
+        q = self._by_node.get(id(node))
+        return self.functions.get(q) if q else None

@@ -1,0 +1,832 @@
+"""Interprocedural abstract interpretation over the tpulint call graph.
+
+The domain is deliberately tiny — three facts per value, each one the
+static shadow of something a PR 1-6 incident measured at runtime:
+
+  * provenance: where the VALUE came from, on the lattice
+        STATIC < CONFIG < BUCKETED < RUNTIME
+    (join = max). RUNTIME means "derived from runtime data in a way that
+    varies call-to-call" — `len(queue)`, `x.shape` of an unknown array —
+    exactly the values that, used as traced shapes or static_argnums,
+    make `obs/recompile.py`'s counters climb. BUCKETED means the value
+    went through a pow2 bucketer (`_bucket`-style doubling loop,
+    `.bit_length()`, `_pack_grouped_args`) and the compile cache stays
+    bounded even though the data varies.
+  * shape provenance: same lattice, but for the SHAPE of an array value
+    (`jnp.zeros(n)` has shape_prov = prov(n); `x + 1` inherits
+    shape_prov(x)). Recompiles track shapes, not values, so the two are
+    propagated separately.
+  * placement: "host" | "device" | "any" — feeds host-sync.
+
+Each value also carries dependency sets naming the enclosing function's
+parameters its prov/shape_prov derive from, tagged ("v", i) for
+value-of-param-i and ("s", i) for shape-of-param-i. Function summaries
+(return value + donation facts + bucketer flag) are substituted at call
+sites through these tags, which is what makes `def make(n): return
+jnp.zeros(n)` poison its callers' shapes while `def f(x): return x * 2`
+merely forwards the argument's shape provenance.
+
+Everything unknown (externals, getattr chains, object state) deliberately
+bottoms out at STATIC/"any": rules fire only on *definite* facts, the
+same under-approximation stance as the PR-4 rules. The fixpoint is
+bounded (MAX_PASSES) and monotone — AVal.join only moves up a finite
+lattice — so termination is structural, not assumed. Stdlib-ast only.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from .core import Module, call_name, dotted, import_aliases
+from .callgraph import CallGraph, FuncInfo, _FUNC_NODES
+
+STATIC, CONFIG, BUCKETED, RUNTIME = 0, 1, 2, 3
+PROV_NAMES = {STATIC: "static", CONFIG: "config",
+              BUCKETED: "bucketed", RUNTIME: "runtime"}
+
+MAX_PASSES = 4
+
+_EMPTY: frozenset = frozenset()
+
+
+def _join_place(a: str, b: str) -> str:
+    if a == b:
+        return a
+    if "device" in (a, b):
+        return "device"  # host op against a device array promotes to device
+    return "any"
+
+
+@dataclass(frozen=True)
+class AVal:
+    """Abstract value: provenance, shape provenance, placement, param deps."""
+
+    prov: int = STATIC
+    shape_prov: int = STATIC
+    placement: str = "any"
+    deps: frozenset = _EMPTY        # {("v"|"s", param_index)} feeding prov
+    shape_deps: frozenset = _EMPTY  # same, feeding shape_prov
+
+    def join(self, other: "AVal") -> "AVal":
+        return AVal(
+            prov=max(self.prov, other.prov),
+            shape_prov=max(self.shape_prov, other.shape_prov),
+            placement=_join_place(self.placement, other.placement),
+            deps=self.deps | other.deps,
+            shape_deps=self.shape_deps | other.shape_deps)
+
+
+def param_placeholder(i: int) -> AVal:
+    return AVal(deps=frozenset({("v", i)}), shape_deps=frozenset({("s", i)}))
+
+
+def substitute(aval: AVal, args: list[AVal]) -> AVal:
+    """Resolve a summary's param deps against actual argument values.
+
+    Deps on params beyond the actual argument list (defaulted params)
+    resolve to STATIC — defaults are literals in this codebase."""
+    prov, deps = aval.prov, set()
+    for kind, i in aval.deps:
+        if i < len(args):
+            a = args[i]
+            prov = max(prov, a.prov if kind == "v" else a.shape_prov)
+            deps |= a.deps if kind == "v" else a.shape_deps
+    shape_prov, shape_deps = aval.shape_prov, set()
+    for kind, i in aval.shape_deps:
+        if i < len(args):
+            a = args[i]
+            shape_prov = max(shape_prov, a.prov if kind == "v" else a.shape_prov)
+            shape_deps |= a.deps if kind == "v" else a.shape_deps
+    return AVal(prov=prov, shape_prov=shape_prov, placement=aval.placement,
+                deps=frozenset(deps), shape_deps=frozenset(shape_deps))
+
+
+@dataclass
+class JitInfo:
+    """One compile entry point: a jit/pjit/shard_map binding or decorated def."""
+
+    key: str                  # "<module>:<bound name>"
+    module: str
+    name: str
+    line: int
+    kind: str                 # "jit" | "shard_map"
+    target: Optional[str]     # qualname of the wrapped python function
+    donate: tuple[int, ...] = ()
+    static_nums: tuple[int, ...] = ()
+    static_names: tuple[str, ...] = ()
+    scope: Optional[str] = None  # enclosing def qualname; None = module level
+
+
+@dataclass
+class Summary:
+    """Per-function interprocedural summary (fixpoint-computed)."""
+
+    ret: AVal = field(default_factory=AVal)
+    donates_params: frozenset = _EMPTY  # param indices donated (transitively)
+    donates_free: bool = False  # donates a global/free/nonlocal buffer
+    bucketer: bool = False      # output is pow2-bucketed regardless of input
+
+    def key(self):
+        return (self.ret, self.donates_params, self.donates_free, self.bucketer)
+
+
+@dataclass
+class DonationSite:
+    """One call that donates buffers, seen from inside some function."""
+
+    call: ast.Call
+    positions: tuple[int, ...]
+    via: str  # "local" (same-scope binding: donation-alias territory),
+    #           "module" (module-level binding, possibly cross-module),
+    #           "jitdef" (call to a donate-decorated def),
+    #           "callee" (plain function whose summary donates params)
+
+
+_DEVICE_CTORS = {"zeros", "ones", "empty", "full", "arange", "linspace",
+                 "zeros_like", "ones_like", "empty_like", "full_like"}
+_LIKE_CTORS = {"zeros_like", "ones_like", "empty_like", "full_like"}
+_WRAP_NAMES = {"jit", "pjit", "shard_map"}
+_BUCKET_NAME_HINTS = ("bucket", "pow2")
+
+
+def _const_int_tuple(node: ast.AST) -> Optional[tuple[int, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _const_str_tuple(node: ast.AST) -> Optional[tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def wrap_call_info(call: ast.Call) -> Optional[dict]:
+    """Decode jax.jit(fn?, ...) / pjit / shard_map / partial(jax.jit, ...).
+
+    Returns {kind, fn (ast node | None), donate, static_nums, static_names}
+    or None when the call is not a compile-entry wrapper."""
+    name = call_name(call)
+    if name is None:
+        return None
+    base = name.split(".")[-1]
+    kws = call.keywords
+    fn = call.args[0] if call.args else None
+    if base == "partial" and call.args:
+        inner = dotted(call.args[0])
+        if inner is None or inner.split(".")[-1] not in _WRAP_NAMES:
+            return None
+        base = inner.split(".")[-1]
+        fn = call.args[1] if len(call.args) > 1 else None
+    elif base not in _WRAP_NAMES:
+        return None
+    donate: tuple[int, ...] = ()
+    static_nums: tuple[int, ...] = ()
+    static_names: tuple[str, ...] = ()
+    for kw in kws:
+        if kw.arg == "donate_argnums":
+            donate = _const_int_tuple(kw.value) or ()
+        elif kw.arg == "static_argnums":
+            static_nums = _const_int_tuple(kw.value) or ()
+        elif kw.arg == "static_argnames":
+            static_names = _const_str_tuple(kw.value) or ()
+    return {"kind": "shard_map" if base == "shard_map" else "jit",
+            "fn": fn, "donate": donate, "static_nums": static_nums,
+            "static_names": static_names}
+
+
+def _is_bucketer(fi: FuncInfo) -> bool:
+    """pow2-bucketing idiom: name hint, `.bit_length()`, or a doubling loop
+    (`while b < n: b *= 2` as in crypto/bls_jax._bucket)."""
+    name = fi.name.lower()
+    if name == "_pack_grouped_args" or any(h in name for h in _BUCKET_NAME_HINTS):
+        return True
+    for node in ast.walk(fi.node):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "bit_length"):
+            return True
+        if isinstance(node, ast.While):
+            for inner in ast.walk(node):
+                if (isinstance(inner, ast.AugAssign)
+                        and isinstance(inner.op, (ast.Mult, ast.LShift))):
+                    return True
+                if (isinstance(inner, ast.Assign)
+                        and isinstance(inner.value, ast.BinOp)
+                        and isinstance(inner.value.op, (ast.Mult, ast.LShift))):
+                    return True
+    return False
+
+
+class DataflowEngine:
+    """Bounded-fixpoint provenance/donation/placement analysis.
+
+    Built once per run (lazily, by the runner) and shared by every
+    `check_context` rule. Query surface:
+
+      * jit_bindings / jit_defs — every compile entry point in the scan;
+      * summaries[qualname] — return AVal + donation facts + bucketer flag;
+      * value_of(expr) — the AVal recorded for any evaluated expression;
+      * jit_info_for_call(mod, call) — the JitInfo a call dispatches to;
+      * donation_sites(qualname) — donating calls inside that function.
+    """
+
+    def __init__(self, graph: CallGraph) -> None:
+        self.graph = graph
+        self.jit_bindings: dict[str, JitInfo] = {}   # "<mod>:<name>" -> info
+        self.jit_defs: dict[str, JitInfo] = {}       # def qualname -> info
+        self.summaries: dict[str, Summary] = {}
+        self.node_values: dict[int, AVal] = {}
+        self.module_envs: dict[str, dict[str, AVal]] = {}
+        self._donations: dict[str, list[DonationSite]] = {}
+        self._aliases: dict[str, dict[str, set[str]]] = {}
+
+    # -- construction ----------------------------------------------------------
+
+    @classmethod
+    def build(cls, mods: list[Module], graph: Optional[CallGraph] = None
+              ) -> "DataflowEngine":
+        graph = graph or CallGraph.build(mods)
+        eng = cls(graph)
+        for m in mods:
+            eng._aliases[m.name] = {
+                "jax": import_aliases(m.tree, ("jax",)),
+                "np": import_aliases(m.tree, ("numpy",)),
+            }
+            eng._collect_jit_bindings(m)
+        for q, fi in graph.functions.items():
+            eng.summaries[q] = Summary(bucketer=_is_bucketer(fi))
+        eng._fixpoint(mods)
+        eng._record(mods)
+        return eng
+
+    def _collect_jit_bindings(self, mod: Module) -> None:
+        # decorated defs, any scope
+        for q, fi in self.graph.functions.items():
+            if fi.module is not mod:
+                continue
+            for deco in fi.node.decorator_list:
+                info = (wrap_call_info(deco) if isinstance(deco, ast.Call)
+                        else None)
+                if info is None:
+                    name = dotted(deco)
+                    if name is None or name.split(".")[-1] not in _WRAP_NAMES:
+                        continue
+                    info = {"kind": "jit", "fn": None, "donate": (),
+                            "static_nums": (), "static_names": ()}
+                ji = JitInfo(
+                    key=f"{mod.name}:{fi.name}", module=mod.name,
+                    name=fi.name, line=fi.node.lineno, kind=info["kind"],
+                    target=q, donate=info["donate"],
+                    static_nums=info["static_nums"],
+                    static_names=info["static_names"], scope=fi.parent)
+                self.jit_defs[q] = ji
+                if fi.parent is None:
+                    self.jit_bindings[ji.key] = ji
+                break
+
+        # assignment bindings: `name = jax.jit(fn, ...)` — module level and
+        # (scope-tagged) function-local
+        def scan(body: list, scope: Optional[str]) -> None:
+            for stmt in body:
+                if isinstance(stmt, _FUNC_NODES):
+                    fi = self.graph.function_for_node(stmt)
+                    scan(stmt.body, fi.qualname if fi else scope)
+                    continue
+                if not (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Call)):
+                    continue
+                info = wrap_call_info(stmt.value)
+                if info is None:
+                    continue
+                target = None
+                fn = info["fn"]
+                if isinstance(fn, ast.Name):
+                    cand = f"{mod.name}:{fn.id}"
+                    if cand in self.graph.functions:
+                        target = cand
+                    else:
+                        b = self.graph.imports[mod.name].get(fn.id)
+                        if b is not None and b[0] == "func":
+                            cand = f"{b[1]}:{b[2]}"
+                            if cand in self.graph.functions:
+                                target = cand
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    ji = JitInfo(
+                        key=f"{mod.name}:{t.id}", module=mod.name,
+                        name=t.id, line=stmt.lineno, kind=info["kind"],
+                        target=target, donate=info["donate"],
+                        static_nums=info["static_nums"],
+                        static_names=info["static_names"], scope=scope)
+                    if scope is None:
+                        self.jit_bindings[ji.key] = ji
+
+        scan(mod.tree.body, None)
+
+    # -- jit call resolution ---------------------------------------------------
+
+    def jit_info_for_call(self, mod: Module, call: ast.Call
+                          ) -> Optional[JitInfo]:
+        """The compile entry point a call dispatches to, when statically
+        resolvable: a module-level binding (by name, by `from x import f`,
+        or by `mod.f`), or a jit-decorated def reached through the call
+        graph. Function-local bindings are out of scope here — they are
+        the same-scope donation-alias rule's territory."""
+        func = call.func
+        imports = self.graph.imports.get(mod.name, {})
+        if isinstance(func, ast.Name):
+            ji = self.jit_bindings.get(f"{mod.name}:{func.id}")
+            if ji is not None:
+                return ji
+            b = imports.get(func.id)
+            if b is not None and b[0] == "func":
+                ji = self.jit_bindings.get(f"{b[1]}:{b[2]}")
+                if ji is not None:
+                    return ji
+        elif isinstance(func, ast.Attribute):
+            name = dotted(func)
+            if name is not None:
+                parts = name.split(".")
+                b = imports.get(parts[0])
+                if b is not None and b[0] == "mod" and len(parts) >= 2:
+                    ji = self.jit_bindings.get(f"{b[1]}:{parts[-1]}")
+                    if ji is not None:
+                        return ji
+        callee = self.graph.resolved.get(id(call))
+        if callee is not None and callee in self.jit_defs:
+            return self.jit_defs[callee]
+        return None
+
+    def donation_sites(self, qualname: str) -> list[DonationSite]:
+        return self._donations.get(qualname, [])
+
+    def value_of(self, node: ast.AST) -> AVal:
+        return self.node_values.get(id(node), AVal())
+
+    # -- fixpoint --------------------------------------------------------------
+
+    def _fixpoint(self, mods: list[Module]) -> None:
+        for m in mods:
+            self.module_envs[m.name] = {}
+        for _ in range(MAX_PASSES):
+            changed = False
+            for m in mods:
+                env = _Evaluator(self, m).exec_module()
+                if env != self.module_envs[m.name]:
+                    self.module_envs[m.name] = env
+                    changed = True
+            for q, fi in self.graph.functions.items():
+                old = self.summaries[q]
+                new = _Evaluator(self, fi.module).summarize(fi, old)
+                if new.key() != old.key():
+                    self.summaries[q] = new
+                    changed = True
+            if not changed:
+                break
+
+    def _record(self, mods: list[Module]) -> None:
+        """Final pass: re-evaluate everything, persisting per-expression
+        AVals and per-function donation sites for the rules to query."""
+        for m in mods:
+            _Evaluator(self, m, record=True).exec_module()
+        for q, fi in self.graph.functions.items():
+            ev = _Evaluator(self, fi.module, record=True)
+            ev.summarize(fi, self.summaries[q])
+            self._donations[q] = ev.donations
+
+
+class _Evaluator:
+    """One evaluation of a module body or function body over the domain."""
+
+    def __init__(self, engine: DataflowEngine, mod: Module,
+                 record: bool = False) -> None:
+        self.engine = engine
+        self.mod = mod
+        self.record = record
+        self.jax_aliases = engine._aliases[mod.name]["jax"]
+        self.np_aliases = engine._aliases[mod.name]["np"]
+        self.donations: list[DonationSite] = []
+        self._fi: Optional[FuncInfo] = None
+        self._local_donators: dict[str, tuple[int, ...]] = {}
+        self._bound_locals: set[str] = set()
+        self._sum = Summary()
+
+    # -- entry points ----------------------------------------------------------
+
+    def exec_module(self) -> dict[str, AVal]:
+        env = dict(self.engine.module_envs.get(self.mod.name, {}))
+        self.exec_stmts(self.mod.tree.body, env, module_level=True)
+        return env
+
+    def summarize(self, fi: FuncInfo, prev: Summary) -> Summary:
+        self._fi = fi
+        self._sum = Summary(donates_params=prev.donates_params,
+                            donates_free=prev.donates_free,
+                            bucketer=prev.bucketer)
+        self._local_donators = self._scan_local_donators(fi.node.body)
+        env = dict(self.engine.module_envs.get(self.mod.name, {}))
+        for i, p in enumerate(fi.params):
+            env[p] = param_placeholder(i)
+        self._bound_locals = set(fi.params)
+        self.exec_stmts(fi.node.body, env)
+        if self._sum.bucketer:
+            # bucketer output is pow2-clamped whatever flowed in
+            self._sum.ret = AVal(prov=BUCKETED, shape_prov=BUCKETED,
+                                 placement=self._sum.ret.placement)
+        return self._sum
+
+    def _scan_local_donators(self, body: list) -> dict[str, tuple[int, ...]]:
+        """Same-scope `f = jax.jit(..., donate_argnums=...)` bindings —
+        donation-alias's territory, tracked so sites route via='local'."""
+        out: dict[str, tuple[int, ...]] = {}
+        for stmt in body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                info = wrap_call_info(stmt.value)
+                if info is not None and info["donate"]:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = info["donate"]
+        return out
+
+    # -- statements ------------------------------------------------------------
+
+    def exec_stmts(self, stmts: list, env: dict[str, AVal],
+                   module_level: bool = False) -> None:
+        for stmt in stmts:
+            self.exec_stmt(stmt, env, module_level)
+
+    def exec_stmt(self, stmt: ast.AST, env: dict[str, AVal],
+                  module_level: bool = False) -> None:
+        if isinstance(stmt, _FUNC_NODES + (ast.ClassDef,)):
+            return  # separate scopes; functions get their own summaries
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value, env)
+            for t in stmt.targets:
+                self._bind(t, stmt.value, val, env, module_level)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                val = self.eval(stmt.value, env)
+                self._bind(stmt.target, stmt.value, val, env, module_level)
+        elif isinstance(stmt, ast.AugAssign):
+            val = self.eval(stmt.value, env)
+            if isinstance(stmt.target, ast.Name):
+                cur = env.get(stmt.target.id, AVal())
+                env[stmt.target.id] = cur.join(val)
+                self._bound_locals.add(stmt.target.id)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                val = self.eval(stmt.value, env)
+                self._sum.ret = self._sum.ret.join(val)
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self.eval(stmt.iter, env)
+            self._bind(stmt.target, None, replace(it, shape_prov=STATIC,
+                                                  shape_deps=_EMPTY), env, False)
+            self.exec_stmts(stmt.body, env)
+            self.exec_stmts(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            self.eval(stmt.test, env)
+            self.exec_stmts(stmt.body, env)
+            self.exec_stmts(stmt.orelse, env)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            self.exec_stmts(stmt.body, env)
+            self.exec_stmts(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                val = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, None, val, env, False)
+            self.exec_stmts(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            self.exec_stmts(stmt.body, env)
+            for h in stmt.handlers:
+                self.exec_stmts(h.body, env)
+            self.exec_stmts(stmt.orelse, env)
+            self.exec_stmts(stmt.finalbody, env)
+        elif isinstance(stmt, (ast.Assert, ast.Raise, ast.Delete)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.eval(child, env)
+
+    def _bind(self, target: ast.AST, value_node: Optional[ast.AST],
+              val: AVal, env: dict[str, AVal], module_level: bool) -> None:
+        if isinstance(target, ast.Name):
+            if (module_level and target.id.isupper()
+                    and value_node is not None
+                    and _is_literal(value_node)):
+                val = AVal(prov=CONFIG, placement="host")
+            env[target.id] = val
+            self._bound_locals.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, None, val, env, module_level)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, None, val, env, module_level)
+        # attribute/subscript stores: object state is out of the domain
+
+    # -- expressions -----------------------------------------------------------
+
+    def eval(self, node: ast.AST, env: dict[str, AVal]) -> AVal:
+        val = self._eval(node, env)
+        if self.record:
+            self.engine.node_values[id(node)] = val
+        return val
+
+    def _eval(self, node: ast.AST, env: dict[str, AVal]) -> AVal:
+        if isinstance(node, ast.Constant):
+            return AVal(placement="host")
+        if isinstance(node, ast.Name):
+            return env.get(node.id, AVal())
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node, env)
+        if isinstance(node, ast.BinOp):
+            return self.eval(node.left, env).join(self.eval(node.right, env))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            out = AVal(placement="host")
+            for v in node.values:
+                out = out.join(self.eval(v, env))
+            return out
+        if isinstance(node, ast.Compare):
+            out = self.eval(node.left, env)
+            for c in node.comparators:
+                out = out.join(self.eval(c, env))
+            return replace(out, shape_prov=STATIC, shape_deps=_EMPTY)
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test, env)
+            return self.eval(node.body, env).join(self.eval(node.orelse, env))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = AVal(placement="host")
+            for e in node.elts:
+                if isinstance(e, ast.Starred):
+                    e = e.value
+                out = out.join(self.eval(e, env))
+            return out
+        if isinstance(node, ast.Dict):
+            out = AVal(placement="host")
+            for k, v in zip(node.keys, node.values):
+                if k is not None:
+                    out = out.join(self.eval(k, env))
+                out = out.join(self.eval(v, env))
+            return out
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, env)
+            self.eval(node.slice, env)
+            return base
+        if isinstance(node, ast.NamedExpr):
+            val = self.eval(node.value, env)
+            if isinstance(node.target, ast.Name):
+                env[node.target.id] = val
+                self._bound_locals.add(node.target.id)
+            return val
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.eval(v.value, env)
+            return AVal(placement="host")
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.eval(part, env)
+            return AVal(placement="host")
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                it = self.eval(gen.iter, env)
+                self._bind(gen.target, None, it, env, False)
+            return self.eval(node.elt, env)
+        if isinstance(node, ast.DictComp):
+            for gen in node.generators:
+                it = self.eval(gen.iter, env)
+                self._bind(gen.target, None, it, env, False)
+            self.eval(node.key, env)
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Lambda):
+            return AVal()
+        if isinstance(node, (ast.Await, ast.YieldFrom)):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Yield):
+            if node.value is not None:
+                self.eval(node.value, env)
+            return AVal()
+        return AVal()
+
+    def _eval_attribute(self, node: ast.Attribute, env: dict[str, AVal]) -> AVal:
+        base = self.eval(node.value, env)
+        attr = node.attr
+        if attr in ("shape", "size", "nbytes", "ndim"):
+            # the VALUE of x.shape is runtime-varying exactly when x's
+            # SHAPE is — this is the shape->value crossover point
+            return AVal(prov=base.shape_prov, placement="host",
+                        deps=base.shape_deps)
+        if attr.isupper():
+            return AVal(prov=CONFIG, placement="host")
+        if attr in ("T", "real", "imag"):
+            return base
+        return AVal(placement=base.placement if base.placement == "device"
+                    else "any")
+
+    def _eval_call(self, call: ast.Call, env: dict[str, AVal]) -> AVal:
+        args = [self.eval(a.value if isinstance(a, ast.Starred) else a, env)
+                for a in call.args]
+        for kw in call.keywords:
+            self.eval(kw.value, env)
+        self._note_donations(call, env)
+
+        func = call.func
+        name = call_name(call)
+
+        # builtins with provenance significance
+        if isinstance(func, ast.Name):
+            if func.id == "len" and len(call.args) == 1:
+                if _is_literal(call.args[0]):
+                    return AVal(placement="host")
+                return AVal(prov=RUNTIME, placement="host")
+            if func.id in ("int", "float", "bool", "complex") and args:
+                return replace(args[0], shape_prov=STATIC, shape_deps=_EMPTY,
+                               placement="host")
+            if func.id in ("min", "max", "sum", "abs", "round", "divmod"):
+                out = AVal(placement="host")
+                for a in args:
+                    out = out.join(a)
+                return replace(out, shape_prov=STATIC, shape_deps=_EMPTY)
+            if func.id in ("range", "enumerate", "zip", "sorted", "reversed",
+                           "list", "tuple", "set", "frozenset"):
+                out = AVal(placement="host")
+                for a in args:
+                    out = out.join(a)
+                return out
+
+        if isinstance(func, ast.Attribute):
+            base = self.eval(func.value, env)
+            if func.attr == "bit_length":
+                return AVal(prov=BUCKETED, placement="host")
+            if func.attr in ("item", "tolist"):
+                return replace(base, placement="host",
+                               shape_prov=STATIC, shape_deps=_EMPTY)
+            if func.attr == "block_until_ready":
+                return base
+            root_name = dotted(func.value)
+            root_alias = root_name.split(".")[0] if root_name else None
+            if root_alias in self.np_aliases:
+                if func.attr in ("asarray", "array"):
+                    src = args[0] if args else AVal()
+                    return AVal(prov=src.prov, shape_prov=src.shape_prov,
+                                placement="host", deps=src.deps,
+                                shape_deps=src.shape_deps)
+                if func.attr in _DEVICE_CTORS:
+                    return self._ctor_val(call, args, "host")
+            if root_alias in self.jax_aliases:
+                if func.attr in _DEVICE_CTORS:
+                    return self._ctor_val(call, args, "device")
+                if func.attr in ("asarray", "array", "astype", "where",
+                                "concatenate", "stack", "reshape"):
+                    out = AVal(placement="device")
+                    for a in args:
+                        out = out.join(a)
+                    return replace(out, placement="device")
+
+        # compile entry point: output lives on device, shape follows inputs
+        ji = self.engine.jit_info_for_call(self.mod, call)
+        if ji is not None:
+            shape_prov, shape_deps = STATIC, set()
+            for a in args:
+                shape_prov = max(shape_prov, a.shape_prov)
+                shape_deps |= a.shape_deps
+            return AVal(shape_prov=shape_prov, placement="device",
+                        shape_deps=frozenset(shape_deps))
+
+        # resolved internal call: substitute the callee summary
+        callee = self.engine.graph.resolved.get(id(call))
+        if callee is not None and callee in self.engine.summaries:
+            s = self.engine.summaries[callee]
+            if s.bucketer:
+                return AVal(prov=BUCKETED, shape_prov=BUCKETED,
+                            placement=s.ret.placement)
+            return substitute(s.ret, args)
+
+        # local same-scope jit binding (donation-alias territory): device out
+        if isinstance(func, ast.Name) and func.id in self._local_donators:
+            return AVal(placement="device")
+
+        # generic method call: follow the receiver (`base` from the branch above)
+        if isinstance(func, ast.Attribute):
+            out = base
+            for a in args:
+                out = out.join(a)
+            return replace(out, placement=base.placement)
+
+        return AVal()
+
+    def _ctor_val(self, call: ast.Call, args: list[AVal], placement: str
+                  ) -> AVal:
+        """jnp.zeros(n) and friends: shape provenance = provenance of the
+        size argument (or shape provenance of the template for *_like)."""
+        fname = call.func.attr if isinstance(call.func, ast.Attribute) else ""
+        if not args:
+            return AVal(placement=placement)
+        if fname in _LIKE_CTORS:
+            src = args[0]
+            return AVal(shape_prov=src.shape_prov, placement=placement,
+                        shape_deps=src.shape_deps)
+        if fname == "full":
+            size = args[0]
+        elif fname == "arange" or fname == "linspace":
+            size = args[0]
+            for a in args[1:]:
+                size = size.join(a)
+        else:
+            size = args[0]
+        return AVal(shape_prov=size.prov, placement=placement,
+                    shape_deps=size.deps)
+
+    # -- donation tracking -----------------------------------------------------
+
+    def _note_donations(self, call: ast.Call, env: dict[str, AVal]) -> None:
+        positions: tuple[int, ...] = ()
+        via = ""
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in self._local_donators:
+            positions, via = self._local_donators[func.id], "local"
+        elif isinstance(func, ast.Call):
+            info = wrap_call_info(func)
+            if info is not None and info["donate"]:
+                positions, via = info["donate"], "local"
+        if not positions:
+            ji = self.engine.jit_info_for_call(self.mod, call)
+            if ji is not None and ji.donate:
+                positions = ji.donate
+                via = "jitdef" if ji.target in self.engine.jit_defs else "module"
+        if not positions:
+            callee = self.engine.graph.resolved.get(id(call))
+            if callee is not None:
+                s = self.engine.summaries.get(callee)
+                if s is not None and s.donates_params:
+                    positions, via = tuple(sorted(s.donates_params)), "callee"
+        if not positions:
+            return
+        self.donations.append(DonationSite(call=call, positions=positions,
+                                           via=via))
+        if self._fi is None:
+            return
+        for p in positions:
+            if p < len(call.args) and isinstance(call.args[p], ast.Name):
+                nm = call.args[p].id
+                if nm in self._fi.params:
+                    self._sum.donates_params = (self._sum.donates_params
+                                                | {self._fi.params.index(nm)})
+                elif nm not in self._bound_locals:
+                    self._sum.donates_free = True
+            else:
+                # donating a non-name expression: treat as free-donating
+                self._sum.donates_free = True
+        callee = self.engine.graph.resolved.get(id(call))
+        if callee is not None:
+            s = self.engine.summaries.get(callee)
+            if s is not None and s.donates_free:
+                self._sum.donates_free = True
+
+
+class AnalysisContext:
+    """Shared interprocedural state, built lazily once per run by the runner
+    and handed to every rule that implements `check_context(ctx)`."""
+
+    def __init__(self, mods: list[Module]) -> None:
+        self.mods = mods
+        self.graph = CallGraph.build(mods)
+        self.engine = DataflowEngine.build(mods, self.graph)
+
+
+def _is_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return all(k is not None and _is_literal(k) and _is_literal(v)
+                   for k, v in zip(node.keys, node.values))
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    return False

@@ -1,0 +1,172 @@
+"""Rule `donation-flow`: cross-call donation hazards the same-scope rule
+cannot see.
+
+`donation-alias` (PR 4) deliberately stops at scope boundaries: it catches
+`step = jax.jit(f, donate_argnums=(0,)); step(cols); cols.sum()` inside one
+function and nothing else. The PR-5 fault-tolerance work created exactly the
+flows it misses:
+
+  * read-after-donate THROUGH a call: `consume(cols)` donates `cols` to a
+    module-level (or imported, or decorated) jit binding somewhere down the
+    call chain, and the caller keeps reading `cols` — the donation summary
+    of every callee is known to the dataflow engine, so the taint survives
+    the call boundary;
+  * retry wrapping a donating callee: `call_with_retry(fn, ...)` re-invokes
+    `fn` after a failure, but if `fn` donated its arguments (or a captured
+    buffer) on the first attempt, the second attempt replays with buffers
+    XLA may already have reused — the PR-5 "post-donation retry is unsafe"
+    incident class, now caught statically.
+
+Same-scope donating bindings route via='local' in the engine and are skipped
+here — they are donation-alias's territory, and double-reporting would break
+the exact-match fixture contract.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, call_name
+from .donation import _ordered_nodes
+
+RULE_ID = "donation-flow"
+HINT = ("copy before the donating call, rebind from its result, or move the "
+        "retry boundary above buffer creation so each attempt owns fresh "
+        "buffers")
+
+_RETRY_NAMES = {"call_with_retry"}
+
+
+class DonationFlowRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "no cross-call read-after-donate; no retry around a donating callee"
+
+    def check_context(self, ctx) -> list[Finding]:
+        findings: list[Finding] = []
+        for q, fi in sorted(ctx.graph.functions.items()):
+            findings.extend(self._read_after_donate(ctx, q, fi))
+        findings.extend(self._retry_checks(ctx))
+        return findings
+
+    # -- read-after-donate across calls ---------------------------------------
+
+    def _read_after_donate(self, ctx, qualname: str, fi) -> list[Finding]:
+        sites = {id(d.call): d for d in ctx.engine.donation_sites(qualname)
+                 if d.via != "local"}
+        if not sites:
+            return []
+        mod = fi.module
+        findings: list[Finding] = []
+        tainted: dict[str, int] = {}
+        exempt: set[int] = set()
+        for node in _ordered_nodes(fi.node.body):
+            if isinstance(node, ast.Call) and id(node) in sites:
+                d = sites[id(node)]
+                for p in d.positions:
+                    if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                        arg = node.args[p]
+                        tainted[arg.id] = node.lineno
+                        exempt.add(id(arg))
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    if node.id in tainted and id(node) not in exempt:
+                        findings.append(Finding(
+                            path=mod.rel, line=node.lineno, rule=self.id,
+                            severity="error",
+                            message=(f"read of '{node.id}' after the call on "
+                                     f"line {tainted[node.id]} donated it to "
+                                     "a jit entry down the call chain "
+                                     "(buffer may be reused for outputs)"),
+                            hint=HINT))
+                        del tainted[node.id]  # one finding per donation
+                elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                    tainted.pop(node.id, None)
+        return findings
+
+    # -- retry wrapping a donating callee -------------------------------------
+
+    def _retry_checks(self, ctx) -> list[Finding]:
+        findings: list[Finding] = []
+        for site in ctx.graph.calls:
+            call = site.node
+            name = call_name(call)
+            if name is None or name.split(".")[-1] not in _RETRY_NAMES:
+                continue
+            if not call.args:
+                continue
+            target = call.args[0]
+            reason = None
+            if isinstance(target, ast.Name):
+                reason = self._name_donates(ctx, site, target.id)
+            elif isinstance(target, ast.Lambda):
+                reason = self._lambda_donates(ctx, site.module, target)
+            if reason:
+                findings.append(Finding(
+                    path=site.module.rel, line=call.lineno, rule=self.id,
+                    severity="error",
+                    message=("retry wraps a donating callee: " + reason
+                             + " — a second attempt would replay with "
+                               "already-donated buffers"),
+                    hint=HINT))
+        return findings
+
+    def _resolve_in_scope(self, ctx, site, name: str) -> Optional[str]:
+        """Resolve a bare function reference the way the callgraph resolves
+        calls: enclosing def scopes innermost-out, module scope, imports."""
+        g = ctx.graph
+        q = site.caller
+        while q is not None:
+            cand = f"{q}.{name}"
+            if cand in g.functions:
+                return cand
+            q = g.functions[q].parent
+        cand = f"{site.module.name}:{name}"
+        if cand in g.functions:
+            return cand
+        b = g.imports.get(site.module.name, {}).get(name)
+        if b is not None and b[0] == "func":
+            cand = f"{b[1]}:{b[2]}"
+            if cand in g.functions:
+                return cand
+        return None
+
+    def _name_donates(self, ctx, site, name: str) -> Optional[str]:
+        q = self._resolve_in_scope(ctx, site, name)
+        if q is None:
+            return None
+        s = ctx.engine.summaries.get(q)
+        if s is None:
+            return None
+        if s.donates_params:
+            pos = ", ".join(str(p) for p in sorted(s.donates_params))
+            return (f"'{name}' donates its argument(s) at position(s) {pos}")
+        if s.donates_free:
+            return f"'{name}' donates a captured/global buffer"
+        return None
+
+    def _lambda_donates(self, ctx, mod, lam: ast.Lambda) -> Optional[str]:
+        own = {a.arg for a in (*lam.args.posonlyargs, *lam.args.args,
+                               *lam.args.kwonlyargs)}
+        for node in ast.walk(lam.body):
+            if not isinstance(node, ast.Call):
+                continue
+            positions: tuple[int, ...] = ()
+            ji = ctx.engine.jit_info_for_call(mod, node)
+            if ji is not None and ji.donate:
+                positions = ji.donate
+            else:
+                callee = ctx.graph.resolved.get(id(node))
+                if callee is not None:
+                    s = ctx.engine.summaries.get(callee)
+                    if s is not None:
+                        if s.donates_free:
+                            return ("the lambda calls a function that "
+                                    "donates a captured/global buffer")
+                        if s.donates_params:
+                            positions = tuple(sorted(s.donates_params))
+            for p in positions:
+                if p < len(node.args) and isinstance(node.args[p], ast.Name) \
+                        and node.args[p].id not in own:
+                    return (f"the lambda donates captured '{node.args[p].id}'")
+        return None

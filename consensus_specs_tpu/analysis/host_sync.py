@@ -1,0 +1,127 @@
+"""Rule `host-sync`: no implicit device→host syncs in ops/engine hot loops.
+
+`float(y)`, `int(y)`, `bool(y)`, `.item()`, `.tolist()`, `np.asarray(y)`,
+and `.block_until_ready()` on a device array all block the host until the
+device catches up. One sync per epoch is a design decision; one sync per
+loop iteration is a pipeline stall — the resident-engine design (PR 2/3)
+exists precisely to keep the epoch loop free of them, and the aux-readout
+path batches its single sync deliberately.
+
+jit-purity already polices syncs *inside* traced code; this rule covers the
+other side: host-side driver loops in `ops/` and `engine/`. A call is
+flagged when all three hold —
+
+  * it matches a sync pattern AND the operand is *definitely* on device
+    (placement tracked by the dataflow engine; `.block_until_ready()` is
+    jax-only so it needs no placement proof);
+  * it executes in a hot loop: lexically inside for/while, or in a function
+    that some call site places inside a loop (transitive, fixpoint);
+  * it is not jit-reachable (that territory belongs to jit-purity).
+
+Warning severity: a deliberate once-per-batch sync in a loop is sometimes
+the right call — suppress with a justification, as with jit-purity's np
+findings.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, Module, path_matches
+from .jit_purity import _FuncIndex, _jit_roots, _reachable
+
+RULE_ID = "host-sync"
+HINT = ("hoist the sync out of the loop, batch readouts into one "
+        "device->host copy per epoch, or keep values on device")
+
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist"}
+
+
+class HostSyncRule:
+    id = RULE_ID
+    severity = "warning"
+    doc = "no implicit device->host syncs inside ops/ and engine/ hot loops"
+
+    def check_context(self, ctx) -> list[Finding]:
+        in_scope = [m for m in ctx.mods
+                    if path_matches(m.rel, "ops/")
+                    or path_matches(m.rel, "engine/")]
+        if not in_scope:
+            return []
+        loop_called = self._loop_called(ctx)
+        findings: list[Finding] = []
+        for mod in in_scope:
+            findings.extend(self._check_module(ctx, mod, loop_called))
+        return findings
+
+    def _loop_called(self, ctx) -> set:
+        """Function qualnames that execute inside some loop: a call site in a
+        for/while, or a caller that is itself loop-called (fixpoint)."""
+        g = ctx.graph
+        out: set = set()
+        changed = True
+        while changed:
+            changed = False
+            for q in g.functions:
+                if q in out:
+                    continue
+                for s in g.callers.get(q, ()):
+                    if g.in_loop(s.module, s.node) or (
+                            s.caller is not None and s.caller in out):
+                        out.add(q)
+                        changed = True
+                        break
+        return out
+
+    def _check_module(self, ctx, mod: Module, loop_called: set
+                      ) -> list[Finding]:
+        eng, g = ctx.engine, ctx.graph
+        index = _FuncIndex()
+        index.visit(mod.tree)
+        jit_nodes = {id(fn) for fn in
+                     _reachable(_jit_roots(mod.tree, index.defs), index.defs)}
+        np_aliases = eng._aliases.get(mod.name, {}).get("np", set())
+        findings: list[Finding] = []
+        for call in ast.walk(mod.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            desc = self._sync_desc(eng, call, np_aliases)
+            if desc is None:
+                continue
+            fi = g.enclosing_function(mod, call)
+            if fi is not None and id(fi.node) in jit_nodes:
+                continue  # jit-purity's territory
+            hot = g.in_loop(mod, call) or (
+                fi is not None and fi.qualname in loop_called)
+            if not hot:
+                continue
+            findings.append(Finding(
+                path=mod.rel, line=call.lineno, rule=self.id,
+                severity=self.severity,
+                message=(f"implicit device->host sync ({desc}) inside a hot "
+                         "loop stalls the pipeline once per iteration"),
+                hint=HINT))
+        return findings
+
+    def _sync_desc(self, eng, call: ast.Call, np_aliases: set
+                   ) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in _CAST_BUILTINS and len(call.args) == 1 \
+                    and eng.value_of(call.args[0]).placement == "device":
+                return f"{func.id}() on a device array"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        if func.attr == "block_until_ready":
+            return ".block_until_ready()"
+        if func.attr in _SYNC_METHODS \
+                and eng.value_of(func.value).placement == "device":
+            return f".{func.attr}() on a device array"
+        if func.attr in ("asarray", "array") and call.args \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id in np_aliases \
+                and eng.value_of(call.args[0]).placement == "device":
+            return f"np.{func.attr}() on a device array"
+        return None

@@ -1,0 +1,102 @@
+"""Rule `recompile-risk`: no unbucketed runtime values in traced shapes.
+
+The runtime half of this story is `obs/recompile.py`: PR 6 gave the epoch
+pipeline a CompileTracker because compile-cache pressure is invisible until
+a production scenario mixes batch sizes and every epoch pays a fresh XLA
+compile. The static half is this rule — the shift from *measuring*
+recompiles to *predicting* them at review time.
+
+A jit/pjit/shard_map entry point recompiles when a traced argument changes
+shape or a static argument changes value. Both are fine when the value is a
+literal, a config constant, or pow2-bucketed (`crypto/bls_jax._bucket`,
+`_pack_grouped_args`): the cache stays bounded. They are NOT fine when the
+value derives from runtime data — `len(queue)` flowing into `jnp.zeros`
+gives one executable per queue length. The dataflow engine tracks exactly
+this provenance interprocedurally, so the flow can cross any number of
+helper functions and still be caught at the jit call site.
+
+Only *definite* runtime provenance fires; unknown values under-approximate
+to static, and call sites already inside jit-traced code are skipped (the
+outer entry point is the one whose cache churns). Warning severity: like
+jit-purity's np findings, sanctioned exceptions carry a suppression with a
+justification or live in the frozen baseline.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module
+from .dataflow import RUNTIME
+
+RULE_ID = "recompile-risk"
+HINT = ("route the size through a pow2 bucketer (crypto/bls_jax._bucket / "
+        "_pack_grouped_args style) before it reaches a traced shape or "
+        "static arg, or hoist it to a config constant")
+
+
+class RecompileRiskRule:
+    id = RULE_ID
+    severity = "warning"
+    doc = "no unbucketed runtime-derived shapes/static args at jit call sites"
+
+    def check_context(self, ctx) -> list[Finding]:
+        eng, graph = ctx.engine, ctx.graph
+        traced = set(eng.jit_defs) | {
+            ji.target for ji in eng.jit_bindings.values() if ji.target}
+        findings: list[Finding] = []
+        for mod in ctx.mods:
+            for call in ast.walk(mod.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                ji = eng.jit_info_for_call(mod, call)
+                if ji is None:
+                    continue
+                if self._inside_traced(graph, mod, call, traced):
+                    continue
+                parts = self._bad_args(eng, ji, call)
+                if parts:
+                    findings.append(Finding(
+                        path=mod.rel, line=call.lineno, rule=self.id,
+                        severity=self.severity,
+                        message=(f"call to jit entry '{ji.name}' passes "
+                                 + "; ".join(parts)
+                                 + " — each distinct value compiles a new "
+                                   "executable"),
+                        hint=HINT))
+        return findings
+
+    def _inside_traced(self, graph, mod: Module, call: ast.Call,
+                       traced: set) -> bool:
+        fi = graph.enclosing_function(mod, call)
+        q = fi.qualname if fi is not None else None
+        while q is not None:
+            if q in traced:
+                return True
+            q = graph.functions[q].parent
+        return False
+
+    def _bad_args(self, eng, ji, call: ast.Call) -> list[str]:
+        parts: list[str] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            av = eng.value_of(arg)
+            if i in ji.static_nums:
+                if av.prov == RUNTIME:
+                    parts.append(f"runtime-derived value at static_argnums "
+                                 f"position {i}")
+            elif av.shape_prov == RUNTIME:
+                parts.append(f"a runtime-shaped array at position {i} "
+                             "(unbucketed size)")
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            av = eng.value_of(kw.value)
+            if kw.arg in ji.static_names:
+                if av.prov == RUNTIME:
+                    parts.append(f"runtime-derived value for static argname "
+                                 f"'{kw.arg}'")
+            elif av.shape_prov == RUNTIME:
+                parts.append(f"a runtime-shaped array for '{kw.arg}' "
+                             "(unbucketed size)")
+        return parts

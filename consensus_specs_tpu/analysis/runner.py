@@ -1,10 +1,21 @@
 """tpulint driver: collect modules, run all rule passes, apply suppressions.
 
-Per-module rules implement `check_module(Module)`; project rules (the import
-DAG) implement `check_project(list[Module])` and run once over the whole
-scan so transitive-import chains resolve. Suppressed findings are dropped
-here (and counted), so every front-end — CLI, pytest integration, baseline
-writer — sees the same post-suppression stream.
+Rules implement any of three hooks:
+
+  * `check_module(Module)` — per-file AST pass (the PR-4 rules);
+  * `check_project(list[Module])` — one pass over the whole scan (the
+    import-layering DAG);
+  * `check_context(AnalysisContext)` — interprocedural pass over the shared
+    call graph + dataflow engine. The context is built lazily, once, on the
+    first rule that asks for it, so `--rules jit-purity` runs stay as cheap
+    as they were in PR 4.
+
+Suppressed findings are dropped here (and counted), so every front-end —
+CLI, pytest integration, baseline writer — sees the same post-suppression
+stream. The runner also records WHICH suppression absorbed each dropped
+finding; the stale-suppression rule turns the unused remainder into
+warnings (it runs last, driven directly by the runner, because the used-set
+only exists after filtering).
 """
 from __future__ import annotations
 
@@ -12,11 +23,17 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .core import Finding, Module, collect_modules
+from .dataflow import AnalysisContext
 from .donation import DonationAliasRule
+from .donation_flow import DonationFlowRule
 from .dtype_pins import DtypePinRule
+from .host_sync import HostSyncRule
 from .jit_purity import JitPurityRule
 from .layering import ImportLayeringRule
+from .recompile_risk import RecompileRiskRule
 from .scatter import NoScatterRule
+from .seam_coverage import SeamCoverageRule
+from .suppressions import StaleSuppressionRule
 
 ALL_RULES = (
     JitPurityRule(),
@@ -24,6 +41,11 @@ ALL_RULES = (
     DonationAliasRule(),
     ImportLayeringRule(),
     NoScatterRule(),
+    RecompileRiskRule(),
+    DonationFlowRule(),
+    SeamCoverageRule(),
+    HostSyncRule(),
+    StaleSuppressionRule(),
 )
 
 
@@ -48,6 +70,7 @@ class AnalysisResult:
 
 def run_rules(mods: list[Module], rules=ALL_RULES) -> tuple[list[Finding], int]:
     raw: list[Finding] = []
+    ctx = None
     for rule in rules:
         check_module = getattr(rule, "check_module", None)
         if check_module is not None:
@@ -56,15 +79,40 @@ def run_rules(mods: list[Module], rules=ALL_RULES) -> tuple[list[Finding], int]:
         check_project = getattr(rule, "check_project", None)
         if check_project is not None:
             raw.extend(check_project(mods))
+        check_context = getattr(rule, "check_context", None)
+        if check_context is not None:
+            if ctx is None:
+                ctx = AnalysisContext(mods)
+            raw.extend(check_context(ctx))
 
     by_rel = {m.rel: m for m in mods}
-    kept, suppressed = [], 0
+    kept: list[Finding] = []
+    suppressed = 0
+    used: set[tuple[str, int, str]] = set()
     for f in raw:
         mod = by_rel.get(f.path)
         if mod is not None and mod.suppressed(f.line, f.rule):
             suppressed += 1
+            rules_at = mod.suppressions.get(f.line, set())
+            used.add((f.path, f.line,
+                      f.rule if f.rule in rules_at else "*"))
             continue
         kept.append(f)
+
+    stale_rule = next((r for r in rules if isinstance(r, StaleSuppressionRule)),
+                      None)
+    if stale_rule is not None:
+        active_ids = {r.id for r in rules}
+        known_ids = {r.id for r in ALL_RULES}
+        full_run = known_ids <= active_ids
+        for f in stale_rule.collect(mods, used, active_ids, known_ids,
+                                    full_run):
+            mod = by_rel.get(f.path)
+            if mod is not None and mod.suppressed(f.line, f.rule):
+                suppressed += 1
+                continue
+            kept.append(f)
+
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
     return kept, suppressed
 

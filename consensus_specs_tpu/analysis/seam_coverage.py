@@ -1,0 +1,179 @@
+"""Rule `seam-coverage`: every fault seam is observable or it doesn't exist.
+
+PR 6's chaos-reconciliation guarantee: each `robustness/faults.py` seam that
+fires must (a) tick the metrics registry (`fault_fires_total`) and (b) fire
+inside an `obs.trace.span()` scope, so the reconciliation harness can map
+every injected fault to the span tree it perturbed. That guarantee was
+enforced by convention; this rule enforces it statically, so a new seam
+call site added in engine/ or parallel/ can't silently skip instrumentation.
+
+What "wrapped by a span" means here is function-granular and interprocedural,
+matching the idioms the instrumented call sites actually use:
+
+  * the seam call is lexically inside a `with span(...)` block; or
+  * the top-level function containing it opens a span anywhere (the
+    engine/resident.py pattern: `fire()` lives in a nested `attempt()` def
+    while the span wraps the retry loop around it); or
+  * EVERY call site of that function is itself covered (the bridge pattern:
+    `_stage_write_back` has no span of its own but is only ever called from
+    inside `with span("bridge.stage_write_back")`). Computed as a monotone
+    fixpoint from the empty set — a seam-calling function nobody calls is
+    uncovered, not vacuously covered.
+
+Site strings must be constant: reconciliation diffs snapshots by site label,
+and a computed label can't be mapped back to a FaultPlan entry.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module, dotted
+
+RULE_ID = "seam-coverage"
+HINT = ("wrap the seam call site (or its enclosing dispatch) in "
+        "`with obs.trace.span(...)` and keep site labels constant strings; "
+        "fault firing must tick the fault_fires_total counter")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    return name is not None and name.split(".")[-1] == "span"
+
+
+def _contains_span(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.With, ast.AsyncWith)):
+            if any(_is_span_call(item.context_expr) for item in sub.items):
+                return True
+    return False
+
+
+def _ticks_fault_counter(tree: ast.Module) -> bool:
+    """`<registry>.counter("<...fault...>", ...).inc()` anywhere in the
+    module — the `_log` idiom in robustness/faults.py."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inc"):
+            continue
+        recv = node.func.value
+        if (isinstance(recv, ast.Call)
+                and isinstance(recv.func, ast.Attribute)
+                and recv.func.attr == "counter"
+                and recv.args
+                and isinstance(recv.args[0], ast.Constant)
+                and isinstance(recv.args[0].value, str)
+                and "fault" in recv.args[0].value):
+            return True
+    return False
+
+
+class SeamCoverageRule:
+    id = RULE_ID
+    severity = "error"
+    doc = "fault seam call sites sit inside obs.trace spans; seams tick counters"
+
+    def check_context(self, ctx) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in ctx.mods:
+            if mod.name.endswith("robustness.faults"):
+                findings.extend(self._check_faults_module(ctx, mod))
+        return findings
+
+    def _seam_defs(self, ctx, fm: Module) -> list:
+        return [fi for fi in ctx.graph.functions.values()
+                if fi.module is fm and fi.parent is None
+                and not fi.name.startswith("_")
+                and fi.params[:1] == ("site",)]
+
+    def _check_faults_module(self, ctx, fm: Module) -> list[Finding]:
+        seams = self._seam_defs(ctx, fm)
+        if not seams:
+            return []
+        findings: list[Finding] = []
+        if not _ticks_fault_counter(fm.tree):
+            first = min(seams, key=lambda fi: fi.node.lineno)
+            findings.append(Finding(
+                path=fm.rel, line=first.node.lineno, rule=self.id,
+                severity="error",
+                message=("fault seams here never tick a metrics registry "
+                         "counter (fault_fires_total-style); chaos "
+                         "reconciliation cannot count fires"),
+                hint=HINT))
+
+        covered = self._covered_functions(ctx)
+        for fi in seams:
+            for site in ctx.graph.callers.get(fi.qualname, ()):
+                if site.module is fm:
+                    continue  # intra-module plumbing (e.g. _log helpers)
+                findings.extend(
+                    self._check_call_site(ctx, covered, fi.name, site))
+        return findings
+
+    def _covered_functions(self, ctx) -> set:
+        """Top-level function qualnames considered span-covered (fixpoint)."""
+        g = ctx.graph
+        tops = {q: fi for q, fi in g.functions.items() if fi.parent is None}
+        covered = {q for q, fi in tops.items() if _contains_span(fi.node)}
+
+        def site_covered(s) -> bool:
+            if self._lexically_in_span(ctx, s):
+                return True
+            if s.caller is None:
+                return False
+            return g.functions[s.caller].top_qualname in covered
+
+        changed = True
+        while changed:
+            changed = False
+            for q in tops:
+                if q in covered:
+                    continue
+                sites = g.callers.get(q, [])
+                if sites and all(site_covered(s) for s in sites):
+                    covered.add(q)
+                    changed = True
+        return covered
+
+    def _lexically_in_span(self, ctx, site) -> bool:
+        for anc in ctx.graph.ancestors(site.module, site.node):
+            if isinstance(anc, (ast.With, ast.AsyncWith)):
+                if any(_is_span_call(item.context_expr) for item in anc.items):
+                    return True
+        return False
+
+    def _check_call_site(self, ctx, covered: set, seam: str, site
+                         ) -> list[Finding]:
+        findings: list[Finding] = []
+        call = site.node
+        label = None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            label = call.args[0].value
+        else:
+            findings.append(Finding(
+                path=site.module.rel, line=call.lineno, rule=self.id,
+                severity="error",
+                message=(f"fault seam '{seam}' called with a non-constant "
+                         "site label; reconciliation cannot map it to a "
+                         "FaultPlan entry"),
+                hint=HINT))
+
+        ok = self._lexically_in_span(ctx, site)
+        if not ok and site.caller is not None:
+            top = ctx.graph.functions[site.caller].top_qualname
+            ok = top in covered
+        if not ok:
+            where = f" '{label}'" if label else ""
+            findings.append(Finding(
+                path=site.module.rel, line=call.lineno, rule=self.id,
+                severity="error",
+                message=(f"fault seam{where} fires outside any "
+                         "obs.trace.span() scope; chaos reconciliation "
+                         "cannot attribute it"),
+                hint=HINT))
+        return findings

@@ -1,0 +1,100 @@
+"""Rule `stale-suppression`: a suppression that suppresses nothing is debt.
+
+Every `# tpulint: disable=<rule> -- justification` trades a finding for a
+written rationale. When the flagged code is later fixed or deleted, the
+comment outlives its reason and starts lying: reviewers read an active
+exemption where there is none, and a future regression on the same line is
+silently pre-suppressed. This rule closes the loop — the runner records
+which suppressions actually absorbed a finding during the run, and whatever
+remains unused is reported.
+
+Gating keeps partial runs honest:
+
+  * a named suppression is judged only when its rule was in the active set
+    (a `--rules jit-purity` run can't prove a dtype-pin disable stale);
+  * a blanket `# tpulint: disable` is judged only when the FULL rule set
+    ran;
+  * a suppression naming an UNKNOWN rule id is always stale — it never
+    could suppress anything (typos rot fastest);
+  * `disable=stale-suppression` is exempt from judgment (it is the opt-out
+    for this rule itself) but still applies as a normal suppression.
+
+The runner drives this rule directly (it needs the used-suppression set
+that only exists after filtering); the class carries id/severity/doc so the
+CLI lists and selects it like any other rule.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Module
+
+RULE_ID = "stale-suppression"
+HINT = ("delete the comment (the finding it suppressed is gone) or fix the "
+        "rule id if it was a typo")
+
+
+def _string_literal_lines(mod: Module) -> set[int]:
+    """Lines covered by string constants: a docstring that QUOTES the
+    suppression syntax (core.py documents it verbatim) is not a suppression
+    and must not be judged stale."""
+    out: set[int] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            end = getattr(node, "end_lineno", None) or node.lineno
+            out.update(range(node.lineno, end + 1))
+    return out
+
+
+class StaleSuppressionRule:
+    id = RULE_ID
+    severity = "warning"
+    doc = "every `# tpulint: disable` comment still suppresses a live finding"
+
+    def collect(self, mods: list[Module],
+                used: set[tuple[str, int, str]],
+                active_ids: set[str],
+                known_ids: set[str],
+                full_run: bool) -> list[Finding]:
+        findings: list[Finding] = []
+        for mod in mods:
+            doc_lines = _string_literal_lines(mod)
+            for line, rules in sorted(mod.suppressions.items()):
+                if line in doc_lines:
+                    continue
+                for rule in sorted(rules):
+                    f = self._judge(mod, line, rule, used, active_ids,
+                                    known_ids, full_run)
+                    if f is not None:
+                        findings.append(f)
+        return findings
+
+    def _judge(self, mod: Module, line: int, rule: str,
+               used: set, active_ids: set[str], known_ids: set[str],
+               full_run: bool) -> Finding | None:
+        if rule == "*":
+            if not full_run or (mod.rel, line, "*") in used:
+                return None
+            return Finding(
+                path=mod.rel, line=line, rule=self.id,
+                severity=self.severity,
+                message=("blanket '# tpulint: disable' no longer suppresses "
+                         "anything"),
+                hint=HINT)
+        if rule not in known_ids:
+            return Finding(
+                path=mod.rel, line=line, rule=self.id,
+                severity=self.severity,
+                message=(f"suppression names unknown rule '{rule}' "
+                         "(typo? it can never suppress anything)"),
+                hint=HINT)
+        if rule == self.id or rule not in active_ids:
+            return None
+        if (mod.rel, line, rule) in used:
+            return None
+        return Finding(
+            path=mod.rel, line=line, rule=self.id,
+            severity=self.severity,
+            message=(f"suppression for '{rule}' no longer suppresses any "
+                     "finding on this line"),
+            hint=HINT)

@@ -37,7 +37,7 @@ def _candidate_random_bytes(seed: bytes, first_bucket: int, num_buckets: int) ->
     words[:, 9] = (le[:, 4] << 24) | (le[:, 5] << 16) | (le[:, 6] << 8) | le[:, 7]
     words[:, 10] = 0x80 << 24  # terminator after 40 message bytes
     words[:, 15] = 320  # bit length
-    digests = np.asarray(sha256_1block(jnp.asarray(words)))  # (B, 8) u32
+    digests = np.asarray(sha256_1block(jnp.asarray(words)))  # (B, 8) u32  # tpulint: disable=host-sync -- deliberately batched: one readout per _CHUNK candidates
     return np.ascontiguousarray(digests.astype(">u4")).view(np.uint8).reshape(num_buckets, 32)
 
 

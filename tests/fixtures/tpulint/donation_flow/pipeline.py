@@ -1,0 +1,56 @@
+"""donation-flow fixture: the PR-5 post-donation-retry incident class.
+
+Every flow here crosses a call boundary, which is precisely what the
+same-scope donation-alias rule (PR 4) cannot see — the companion test
+asserts donation-alias finds NOTHING in this file while donation-flow finds
+each annotated line.
+"""
+import numpy as np
+
+from donation_flow import kern
+from donation_flow.retrylib import call_with_retry
+
+
+def consume(cols, updates):
+    return kern.step(cols, updates)
+
+
+def epoch(cols, updates):
+    out = consume(cols, updates)
+    checksum = np.sum(cols)  # tpulint-expect: donation-flow
+    return out, checksum
+
+
+def epoch_rebound(cols, updates):
+    cols = consume(cols, updates)
+    return cols  # rebound from the call's result: owning, safe
+
+
+def epoch_copied(cols, updates):
+    snapshot = np.asarray(cols)  # owning copy BEFORE the donating call
+    out = consume(cols, updates)
+    return out, np.sum(snapshot)
+
+
+def _do_epoch(cols, updates):
+    return kern.step(cols, updates)
+
+
+def dispatch_retry_lambda(cols, updates):
+    return call_with_retry(lambda: kern.step(cols, updates))  # tpulint-expect: donation-flow
+
+
+def dispatch_retry_ref(cols, updates):
+    return call_with_retry(lambda: _do_epoch(cols, updates))  # tpulint-expect: donation-flow
+
+
+def dispatch_retry_bare(fn_args):
+    return call_with_retry(_do_epoch)  # tpulint-expect: donation-flow
+
+
+def dispatch_retry_safe(updates):
+    def attempt():
+        fresh = np.zeros(8)
+        return kern.step_clean(fresh, updates)
+
+    return call_with_retry(attempt)

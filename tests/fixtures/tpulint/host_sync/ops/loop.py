@@ -1,0 +1,44 @@
+"""host-sync fixture: per-iteration device->host syncs in driver loops."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _scale(x):
+    return x * 2.0
+
+
+kernel = jax.jit(_scale)
+
+
+def hot_loop(batches):
+    total = 0.0
+    for b in batches:
+        y = kernel(b)
+        total += float(y)  # tpulint-expect: host-sync
+    return total
+
+
+def _sync(y):
+    return y.block_until_ready()  # tpulint-expect: host-sync
+
+
+def drain(batches):
+    out = []
+    for b in batches:
+        out.append(_sync(kernel(b)))
+    return out
+
+
+def readout_once(batches):
+    acc = jnp.zeros(8, dtype=jnp.float32)
+    for b in batches:
+        acc = acc + kernel(b)
+    return float(acc)  # single sync AFTER the loop: the sanctioned pattern
+
+
+def host_only(batches):
+    out = []
+    for b in batches:
+        out.append(float(np.sum(b)))  # host value: no device sync
+    return out

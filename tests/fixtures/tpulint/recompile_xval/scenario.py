@@ -1,0 +1,60 @@
+"""recompile-risk fixture AND dynamic cross-validation scenario.
+
+This module is read two ways: tpulint parses it (jax-free) and must flag
+exactly the annotated lines; tests/test_tpulint_dataflow.py imports it under
+obs/recompile.py's CompileTracker and asserts the static flags agree with
+the observed compile counts — flagged kernels recompile when driven with
+varying queue lengths, unflagged kernels compile exactly once.
+"""
+import jax
+import jax.numpy as jnp
+
+_MIN_BATCH = 8
+
+
+def _scale(x):
+    return x * 2.0
+
+
+def _shift(x):
+    return x + 1.0
+
+
+def _square(x):
+    return x * x
+
+
+def _tail_sum(x, n):
+    return jnp.sum(x[:n])
+
+
+kernel_scale = jax.jit(_scale)
+kernel_shift = jax.jit(_shift)
+kernel_square = jax.jit(_square)
+kernel_tail = jax.jit(_tail_sum, static_argnums=(1,))
+
+
+def _bucket(n):
+    b = _MIN_BATCH
+    while b < n:
+        b *= 2
+    return b
+
+
+def run_varying(queue):
+    buf = jnp.zeros(len(queue))
+    return kernel_scale(buf)  # tpulint-expect: recompile-risk
+
+
+def run_bucketed(queue):
+    buf = jnp.zeros(_bucket(len(queue)))
+    return kernel_shift(buf)
+
+
+def run_fixed():
+    buf = jnp.zeros(16)
+    return kernel_square(buf)
+
+
+def run_static_runtime(x, queue):
+    return kernel_tail(x, len(queue))  # tpulint-expect: recompile-risk

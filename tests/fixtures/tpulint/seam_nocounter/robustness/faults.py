@@ -1,0 +1,7 @@
+"""seam-coverage counter fixture: seams that never tick the registry."""
+_FIRED = []
+
+
+def fire(site):  # tpulint-expect: seam-coverage
+    _FIRED.append(site)
+    return False

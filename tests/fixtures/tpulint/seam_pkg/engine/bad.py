@@ -1,0 +1,14 @@
+"""seam-coverage positive fixture: naked and unlabelable seam call sites."""
+from seam_pkg.obs.trace import span
+from seam_pkg.robustness.faults import fire
+
+
+def uncovered(arr):
+    fire("engine.naked")  # tpulint-expect: seam-coverage
+    return arr
+
+
+def computed_label(site_name, arr):
+    with span("engine.labeled"):
+        fire(site_name)  # tpulint-expect: seam-coverage
+    return arr

@@ -1,0 +1,35 @@
+"""seam-coverage negative fixture: the three sanctioned coverage shapes.
+
+covered_direct  — seam lexically inside `with span(...)`;
+_helper         — no span of its own, but every call site is covered
+                  (the bridge._stage_write_back pattern);
+covered_nested_attempt — seam inside a nested def while the span wraps the
+                  dispatch in the same top-level function (the
+                  resident._dispatch retry pattern).
+"""
+from seam_pkg.obs.trace import span
+from seam_pkg.robustness.faults import corrupt_array, fire
+
+
+def covered_direct(arr):
+    with span("engine.step"):
+        fire("engine.step")
+    return arr
+
+
+def _helper(arr):
+    return corrupt_array("engine.helper", arr)
+
+
+def covered_via_caller(arr):
+    with span("engine.outer"):
+        return _helper(arr)
+
+
+def covered_nested_attempt(arr):
+    def attempt():
+        fire("engine.attempt")
+        return arr
+
+    with span("engine.attempt"):
+        return attempt()

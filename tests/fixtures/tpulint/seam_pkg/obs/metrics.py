@@ -1,0 +1,21 @@
+"""Minimal counter registry mirroring consensus_specs_tpu/obs/metrics.py."""
+
+
+class _Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class _Registry:
+    def __init__(self):
+        self._counters = {}
+
+    def counter(self, name, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        return self._counters.setdefault(key, _Counter())
+
+
+REGISTRY = _Registry()

@@ -1,0 +1,12 @@
+"""Fault seams that tick the registry — the instrumented (correct) shape."""
+from seam_pkg.obs import metrics as _metrics
+
+
+def fire(site):
+    _metrics.REGISTRY.counter("fault_fires_total", site=site).inc()
+    return False
+
+
+def corrupt_array(site, arr):
+    fire(site)
+    return arr

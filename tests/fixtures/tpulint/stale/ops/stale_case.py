@@ -1,0 +1,14 @@
+"""stale-suppression fixture: one live suppression, one stale, one typo'd."""
+import jax.numpy as jnp
+
+
+def table(n):
+    return jnp.arange(n)  # tpulint: disable=dtype-pin -- trace-time ramp table, ambient dtype intended
+
+
+def clean(n):
+    return n + 1  # tpulint: disable=jit-purity -- leftover from a removed print  # tpulint-expect: stale-suppression
+
+
+def typo(n):
+    return n  # tpulint: disable=jit-puirty -- misspelled rule id  # tpulint-expect: stale-suppression

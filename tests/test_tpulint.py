@@ -25,6 +25,7 @@ from consensus_specs_tpu.analysis.baseline import (  # noqa: E402
     diff_against_baseline,
     load_baseline,
 )
+from consensus_specs_tpu.analysis.runner import rule_by_id  # noqa: E402
 
 
 def _expected_annotations(path: Path) -> set:
@@ -112,6 +113,96 @@ def test_suppression_fixture():
     assert result.suppressed == 2
 
 
+# --- per-rule: the interprocedural (PR-7) rule families -----------------------
+
+def test_recompile_risk_fixture():
+    """Unbucketed `len(queue)` flowing into a traced shape — and into a
+    static_argnums slot — is flagged; the pow2-bucketed and fixed-shape
+    paths through the SAME kernels stay clean."""
+    expected = _fixture_matches_annotations(FIXTURES / "recompile_xval")
+    assert {r for _, r in expected} == {"recompile-risk"}
+    assert len(expected) == 2  # shape from raw len(); runtime static arg
+
+
+def test_donation_flow_fixture():
+    """Replay of the PR-5 incident class: read-after-donate THROUGH a call
+    (the donating jit lives in another module) and retry helpers wrapping a
+    donating callee; rebinding, copying, and per-attempt fresh buffers are
+    the sanctioned shapes and stay clean."""
+    expected = _fixture_matches_annotations(FIXTURES / "donation_flow")
+    assert {r for _, r in expected} == {"donation-flow"}
+    assert len(expected) == 4  # cross-call read; lambda/ref/bare retry forms
+
+
+def test_donation_flow_catches_what_same_scope_rule_misses():
+    """Acceptance gate: every hazard in the donation_flow fixture crosses a
+    call boundary, so the PR-4 same-scope donation-alias pass PROVABLY sees
+    nothing there — only the interprocedural rule does."""
+    alias_only = analyze_paths([FIXTURES / "donation_flow"],
+                               (rule_by_id("donation-alias"),))
+    assert alias_only.findings == [], \
+        [f.format() for f in alias_only.findings]
+    flow_only = analyze_paths([FIXTURES / "donation_flow"],
+                              (rule_by_id("donation-flow"),))
+    got = {(f.line, f.rule) for f in flow_only.findings}
+    expected = set()
+    for f in sorted((FIXTURES / "donation_flow").rglob("*.py")):
+        if "__pycache__" not in f.parts:
+            expected |= _expected_annotations(f)
+    assert got == expected
+    # ...and the PR-4 rule still owns its original same-scope fixture.
+    same_scope = analyze_paths([FIXTURES / "donation"],
+                               (rule_by_id("donation-alias"),))
+    assert len(same_scope.findings) == 2
+
+
+def test_seam_coverage_fixture():
+    """PR-6 guarantee, statically: a FaultPlan seam fired outside any
+    obs.trace.span() scope is an error, as is a non-constant site label;
+    direct spans, caller-side spans, and the resident nested-attempt
+    pattern are all recognized as covered."""
+    expected = _fixture_matches_annotations(FIXTURES / "seam_pkg")
+    assert {r for _, r in expected} == {"seam-coverage"}
+    assert len(expected) == 2  # naked call site; computed site label
+
+
+def test_seam_counter_fixture():
+    """A faults module whose seams never tick a fault counter breaks the
+    PR-6 reconciliation contract."""
+    expected = _fixture_matches_annotations(FIXTURES / "seam_nocounter")
+    assert expected == {(5, "seam-coverage")}
+
+
+def test_host_sync_fixture():
+    """Per-iteration device->host syncs in ops/ driver loops are flagged
+    (directly in the loop, and through a loop-called helper); the single
+    post-loop readout and host-only float() stay clean."""
+    expected = _fixture_matches_annotations(FIXTURES / "host_sync")
+    assert {r for _, r in expected} == {"host-sync"}
+    assert len(expected) == 2  # float(y) in loop; block_until_ready helper
+
+
+def test_stale_suppression_fixture():
+    """A disable comment that absorbed nothing this run is itself a finding;
+    a misspelled rule id is ALWAYS stale; the live suppression is not judged
+    and still counts as used."""
+    expected = _fixture_matches_annotations(FIXTURES / "stale")
+    assert {r for _, r in expected} == {"stale-suppression"}
+    result = analyze_paths([FIXTURES / "stale"])
+    assert result.suppressed == 1  # the live dtype-pin disable
+
+
+def test_stale_suppression_gated_on_partial_runs():
+    """--rules subsets must not call live suppressions stale: judging the
+    stale fixture with only dtype-pin + stale-suppression active leaves the
+    jit-purity disable unjudged (its rule never ran)."""
+    rules = (rule_by_id("dtype-pin"), rule_by_id("stale-suppression"))
+    result = analyze_paths([FIXTURES / "stale"], rules)
+    got = {(f.line, f.rule) for f in result.findings}
+    # only the unknown-rule typo is judgeable on a partial run
+    assert got == {(14, "stale-suppression")}
+
+
 # --- integration: the package itself and the baseline ratchet ----------------
 
 def test_package_clean(monkeypatch):
@@ -194,7 +285,9 @@ def test_cli_list_rules():
     res = _run_cli("--list-rules")
     assert res.returncode == 0
     for rule_id in ("jit-purity", "dtype-pin", "donation-alias",
-                    "import-layering", "no-scatter"):
+                    "import-layering", "no-scatter", "recompile-risk",
+                    "donation-flow", "seam-coverage", "host-sync",
+                    "stale-suppression"):
         assert rule_id in res.stdout
 
 
@@ -207,3 +300,58 @@ def test_cli_rules_subset():
     res = _run_cli("--no-baseline", "--rules", "bogus-rule",
                    str(FIXTURES / "layer_pkg"))
     assert res.returncode == 2
+
+
+# --- --since: changed-files-only reporting -----------------------------------
+
+def _load_tpulint_cli():
+    """Import tools/tpulint.py as a module so the test can repoint its REPO
+    at a throwaway git repo (the subprocess CLI is pinned to the real one)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_tpulint_cli_under_test", REPO / "tools" / "tpulint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@example.com", "-c", "user.name=t", *args],
+        cwd=repo, check=True, capture_output=True, text=True, timeout=60)
+
+
+def test_cli_since_filters_to_changed_files(tmp_path, monkeypatch, capsys):
+    """--since runs the FULL analysis but reports only findings on files
+    changed since the ref: a committed-clean tree reports nothing despite
+    live violations; touching one file surfaces that file's findings only."""
+    proj = tmp_path / "proj"
+    ops = proj / "ops"
+    ops.mkdir(parents=True)
+    (ops / "a.py").write_text(
+        "import jax.numpy as jnp\n\n\ndef f(n):\n    return jnp.zeros(n)\n")
+    (ops / "b.py").write_text(
+        "import jax.numpy as jnp\n\n\ndef g(n):\n    return jnp.ones(n)\n")
+    _git(proj, "init", "-q")
+    _git(proj, "add", "-A")
+    _git(proj, "commit", "-q", "-m", "seed")
+
+    cli = _load_tpulint_cli()
+    monkeypatch.setattr(cli, "REPO", proj)
+
+    assert cli.main([str(ops), "--no-baseline", "--since", "HEAD"]) == 0
+    out = capsys.readouterr().out
+    assert "0 findings" in out and "scope:" in out
+
+    (ops / "b.py").write_text(
+        "import jax.numpy as jnp\n\n\ndef g(n):\n    return jnp.arange(n)\n")
+    assert cli.main([str(ops), "--no-baseline", "--since", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "ops/b.py" in out
+    assert "ops/a.py" not in out
+
+
+def test_cli_since_rejects_write_baseline():
+    res = _run_cli("--since", "HEAD", "--write-baseline")
+    assert res.returncode == 2
+    assert "incompatible" in res.stderr

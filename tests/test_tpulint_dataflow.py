@@ -1,0 +1,219 @@
+"""Unit tests for tpulint's interprocedural core — the call graph and the
+provenance dataflow engine — plus the static/dynamic cross-validation that
+anchors recompile-risk to reality: the rule's flags on the shared
+recompile_xval fixture must agree with what obs/recompile.py's
+CompileTracker actually observes when the same module runs under jax.
+
+The callgraph/dataflow tests are jax-free (stdlib ast only, per the
+analysis-package charter); the cross-validation test imports jax inside the
+test body, the same shape tests/test_obs.py uses.
+"""
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "tpulint"
+
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.analysis import analyze_paths  # noqa: E402
+from consensus_specs_tpu.analysis.callgraph import CallGraph  # noqa: E402
+from consensus_specs_tpu.analysis.core import collect_modules  # noqa: E402
+from consensus_specs_tpu.analysis.dataflow import (  # noqa: E402
+    BUCKETED,
+    RUNTIME,
+    STATIC,
+    DataflowEngine,
+)
+from consensus_specs_tpu.analysis.runner import rule_by_id  # noqa: E402
+
+
+def _mods(root: str):
+    mods, errors = collect_modules(FIXTURES / root)
+    assert not errors, [f.format() for f in errors]
+    return mods
+
+
+def _module(mods, dotted_name):
+    return next(m for m in mods if m.name == dotted_name)
+
+
+def _call_to(mod, name: str, line: int | None = None) -> ast.Call:
+    """Call whose func is the bare name or a `mod.name` attribute, lowest
+    line first (optionally pinned to an exact line)."""
+    hits = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Name) and f.id == name) or \
+                (isinstance(f, ast.Attribute) and f.attr == name):
+            if line is None or node.lineno == line:
+                hits.append(node)
+    if not hits:
+        raise AssertionError(f"no call to {name} in {mod.name}")
+    return min(hits, key=lambda n: n.lineno)
+
+
+# --- call graph ---------------------------------------------------------------
+
+
+def test_callgraph_resolves_cross_module_calls():
+    """`from seam_pkg.robustness.faults import fire` call sites in engine/
+    resolve to the faults def; the intra-module corrupt_array->fire edge
+    resolves too."""
+    graph = CallGraph.build(_mods("seam_pkg"))
+    fire_q = "seam_pkg.robustness.faults:fire"
+    assert fire_q in graph.functions
+    caller_mods = {s.module.name for s in graph.callers[fire_q]}
+    assert {"seam_pkg.engine.good", "seam_pkg.engine.bad",
+            "seam_pkg.robustness.faults"} <= caller_mods
+    caller_funcs = {s.caller for s in graph.callers[fire_q]}
+    assert "seam_pkg.robustness.faults:corrupt_array" in caller_funcs
+
+
+def test_callgraph_resolves_module_alias_and_func_imports():
+    """Both production idioms resolve: `from pkg.retrylib import f; f()` and
+    `from pkg import kern; kern.<name>()` (the latter only for real defs —
+    `kern.step` is a jit BINDING, which the callgraph conservatively leaves
+    to the dataflow engine)."""
+    mods = _mods("donation_flow")
+    graph = CallGraph.build(mods)
+    retry_q = "donation_flow.retrylib:call_with_retry"
+    assert retry_q in graph.functions
+    assert {s.caller for s in graph.callers[retry_q]} == {
+        "donation_flow.pipeline:dispatch_retry_lambda",
+        "donation_flow.pipeline:dispatch_retry_ref",
+        "donation_flow.pipeline:dispatch_retry_bare",
+        "donation_flow.pipeline:dispatch_retry_safe",
+    }
+    pipeline = _module(mods, "donation_flow.pipeline")
+    step_call = _call_to(pipeline, "step")
+    assert id(step_call) not in graph.resolved  # binding, not a def
+
+
+def test_callgraph_lexical_queries():
+    mods = _mods("host_sync")
+    graph = CallGraph.build(mods)
+    loop_mod = _module(mods, "host_sync.ops.loop")
+    float_call = _call_to(loop_mod, "float")
+    assert graph.in_loop(loop_mod, float_call)
+    fi = graph.enclosing_function(loop_mod, float_call)
+    assert fi is not None and fi.name == "hot_loop"
+    sync_q = "host_sync.ops.loop:_sync"
+    sync_body_call = _call_to(loop_mod, "block_until_ready")
+    assert not graph.in_loop(loop_mod, sync_body_call)  # loop is in the CALLER
+
+
+# --- dataflow engine ----------------------------------------------------------
+
+
+def test_dataflow_shape_provenance_lattice():
+    """The three run_* paths in the shared scenario hit the three rungs of
+    the lattice: raw len() -> RUNTIME, pow2-bucketed len() -> BUCKETED,
+    literal -> STATIC."""
+    mods = _mods("recompile_xval")
+    engine = DataflowEngine.build(mods)
+    sc = _module(mods, "recompile_xval.scenario")
+    varying = _call_to(sc, "kernel_scale").args[0]
+    bucketed = _call_to(sc, "kernel_shift").args[0]
+    fixed = _call_to(sc, "kernel_square").args[0]
+    assert engine.value_of(varying).shape_prov == RUNTIME
+    assert engine.value_of(bucketed).shape_prov == BUCKETED
+    assert engine.value_of(fixed).shape_prov == STATIC
+
+
+def test_dataflow_detects_bucketer_summary():
+    mods = _mods("recompile_xval")
+    engine = DataflowEngine.build(mods)
+    assert engine.summaries["recompile_xval.scenario:_bucket"].bucketer
+
+
+def test_dataflow_donation_crosses_calls():
+    """Donation facts flow through summaries: `consume` transitively donates
+    its param 0 (via the cross-module `kern.step` jit binding), and `epoch`
+    therefore carries a donation site it never spelled locally."""
+    mods = _mods("donation_flow")
+    engine = DataflowEngine.build(mods)
+    consume = engine.summaries["donation_flow.pipeline:consume"]
+    assert 0 in consume.donates_params
+    epoch_sites = engine.donation_sites("donation_flow.pipeline:epoch")
+    assert epoch_sites and all(s.via != "local" for s in epoch_sites)
+    assert any(0 in s.positions for s in epoch_sites)
+
+
+def test_dataflow_jit_binding_donation_info():
+    mods = _mods("donation_flow")
+    engine = DataflowEngine.build(mods)
+    pipeline = _module(mods, "donation_flow.pipeline")
+    ji = engine.jit_info_for_call(pipeline, _call_to(pipeline, "step"))
+    assert ji is not None and tuple(ji.donate) == (0,)
+    ji_clean = engine.jit_info_for_call(pipeline, _call_to(pipeline, "step_clean"))
+    assert ji_clean is not None and tuple(ji_clean.donate) == ()
+
+
+# --- static/dynamic cross-validation ------------------------------------------
+
+_KERNELS = {  # jit binding name (what the rule reports) -> traced fn name
+    "kernel_scale": "_scale",
+    "kernel_shift": "_shift",
+    "kernel_square": "_square",
+    "kernel_tail": "_tail_sum",
+}
+
+
+def _static_flags() -> set:
+    """Jit entries the recompile-risk pass flags in the shared scenario."""
+    res = analyze_paths([FIXTURES / "recompile_xval"],
+                        (rule_by_id("recompile-risk"),))
+    flagged = set()
+    for f in res.findings:
+        m = re.search(r"jit entry '([^']+)'", f.message)
+        assert m, f.message
+        flagged.add(m.group(1))
+    return flagged
+
+
+def test_recompile_risk_cross_validates_against_tracker():
+    """The acceptance gate for the rule: drive the SAME module tpulint
+    analyzed with varying queue lengths under the PR-6 CompileTracker.
+    Every kernel observed recompiling must be statically flagged (no false
+    negatives on this corpus), and no single-compile kernel may be flagged
+    (no false positives on the bucketed/fixed paths)."""
+    import jax.numpy as jnp
+
+    from consensus_specs_tpu.obs.metrics import MetricsRegistry
+    from consensus_specs_tpu.obs.recompile import CompileTracker
+
+    sys.path.insert(0, str(FIXTURES))
+    try:
+        from recompile_xval import scenario as sc
+    finally:
+        sys.path.remove(str(FIXTURES))
+
+    tracker = CompileTracker(registry=MetricsRegistry()).install()
+    try:
+        x = jnp.arange(16.0)
+        for n in (5, 6, 7):  # one pow2 bucket: bucketed path compiles once
+            queue = list(range(n))
+            sc.run_varying(queue)
+            sc.run_bucketed(queue)
+            sc.run_fixed()
+            sc.run_static_runtime(x, queue)
+    finally:
+        tracker.uninstall()
+
+    compiles = {b: tracker.compiles(fn) for b, fn in _KERNELS.items()}
+    assert all(c >= 1 for c in compiles.values()), compiles
+    observed_varying = {b for b, c in compiles.items() if c > 1}
+    observed_single = {b for b, c in compiles.items() if c == 1}
+    assert observed_varying == {"kernel_scale", "kernel_tail"}, compiles
+    flagged = _static_flags()
+    assert flagged >= observed_varying, (
+        f"runtime recompiles the static pass missed: "
+        f"{observed_varying - flagged} (compiles={compiles})")
+    assert not (flagged & observed_single), (
+        f"static flags on kernels that compiled exactly once: "
+        f"{flagged & observed_single} (compiles={compiles})")

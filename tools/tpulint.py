@@ -7,6 +7,7 @@ Usage:
         [--write-baseline]                        # regenerate (shrink-only)
         [--allow-growth]                          # explicit override for growth
         [--rules id1,id2]                         # subset of passes
+        [--since <git-ref>]                       # report changed files only
         [--list-rules] [--json] [--self-test]
 
 Exit codes: 0 clean (no findings beyond the baseline), 1 new findings (or
@@ -24,6 +25,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -51,6 +53,29 @@ def _canon(finding):
     except ValueError:
         return finding
     return dataclasses.replace(finding, path=rel.as_posix())
+
+
+def _changed_files(ref: str) -> set[str] | None:
+    """Repo-relative paths of .py files changed since `ref` (plus untracked).
+
+    The ANALYSIS always runs over the full package — interprocedural rules
+    need every module to build the call graph — only the REPORT is filtered,
+    so --since never changes what a finding means, just which ones print."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=REPO, capture_output=True, text=True, check=True)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO, capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError) as exc:
+        detail = getattr(exc, "stderr", "") or str(exc)
+        print(f"tpulint: --since {ref}: git failed: {detail.strip()}",
+              file=sys.stderr)
+        return None
+    return {line.strip() for line in
+            (diff.stdout + untracked.stdout).splitlines()
+            if line.strip().endswith(".py")}
 
 
 def _self_test() -> int:
@@ -96,6 +121,7 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true")
     ap.add_argument("--allow-growth", action="store_true")
     ap.add_argument("--rules", default=None)
+    ap.add_argument("--since", default=None, metavar="REF")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--self-test", action="store_true")
@@ -123,6 +149,11 @@ def main(argv=None) -> int:
     if missing:
         print(f"tpulint: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
+    if args.since and args.write_baseline:
+        print("tpulint: --since and --write-baseline are incompatible "
+              "(the baseline must always describe a FULL run)", file=sys.stderr)
+        return 2
+
     result = analyze_paths(paths, rules)
     result.findings = [_canon(f) for f in result.findings]
 
@@ -130,6 +161,20 @@ def main(argv=None) -> int:
     baseline = None
     if not args.no_baseline and baseline_path.exists():
         baseline = load_baseline(baseline_path)
+
+    scope = ""
+    if args.since:
+        changed = _changed_files(args.since)
+        if changed is None:
+            return 2
+        result.findings = [f for f in result.findings if f.path in changed]
+        if baseline is not None:
+            # Keep only baseline entries for changed files, else every frozen
+            # finding on an UNtouched file would count as "fixed".
+            baseline = dict(baseline)
+            baseline["findings"] = [e for e in baseline.get("findings", [])
+                                    if e["path"] in changed]
+        scope = f", scope: {len(changed)} files changed since {args.since}"
 
     if args.write_baseline:
         old_budget = baseline["budget"] if baseline else len(result.findings)
@@ -150,13 +195,16 @@ def main(argv=None) -> int:
                   if baseline else (result.findings, 0))
 
     if args.as_json:
-        print(json.dumps({
+        report = {
             "files": result.file_count,
             "findings": [f.as_json() for f in result.findings],
             "new": [f.as_json() for f in new],
             "suppressed": result.suppressed,
             "fixed_vs_baseline": fixed,
-        }, indent=1))
+        }
+        if args.since:
+            report["since"] = args.since
+        print(json.dumps(report, indent=1))
     else:
         for f in new:
             print(f.format())
@@ -164,7 +212,8 @@ def main(argv=None) -> int:
         print(f"tpulint: {result.file_count} files, "
               f"{len(result.findings)} findings ({len(new)} {label}, "
               f"{result.suppressed} suppressed"
-              + (f", {fixed} fixed vs baseline" if baseline else "") + ")")
+              + (f", {fixed} fixed vs baseline" if baseline else "")
+              + scope + ")")
         if baseline and fixed:
             print("tpulint: baseline entries were fixed — ratchet down with "
                   f"`python tools/tpulint.py --write-baseline` ({baseline_path})")
