@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding
 
-.PHONY: test testall citest testfast chaos lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -49,6 +49,18 @@ chaos:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_chaos_epoch.py tests/test_robustness.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_chaos.json
+
+# Unified verification scheduler lane: admission/collapse/backpressure
+# mechanics, device-vs-host lane agreement, and the compile-cache pin
+# (one XLA compile per (class, bucket)) — see README "Verification
+# scheduler". Writes + validates the lane's obs snapshot like chaos does;
+# the scheduler's own counters/gauges/histograms are the artifact.
+sched:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_sched.json OBS_SNAPSHOT_LANE=sched \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_sched.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_sched.json
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

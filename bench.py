@@ -220,6 +220,10 @@ def run_benches() -> dict:
             import benches.sync_aggregate_bench as sync_bench
 
             sync_r = sync_bench.run()
+        with timed("bench_sched"):
+            import benches.sched_bench as sched_bench
+
+            sched_r = sched_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -292,6 +296,16 @@ def run_benches() -> dict:
             "sync_aggregate_blocks_per_s_warm": sync_r["blocks_per_s_warm"],
             "sync_aggregate_blocks": sync_r["blocks"],
             "sync_aggregate_committee_size": sync_r["committee_size"],
+            # unified verification scheduler mixed lane: per-class items/s
+            # through the shared dispatch seam, steady-state p99
+            # submit->result latency, and the bucketing occupancy floor
+            # (>= 0.75 by construction; a bucketing regression shows here)
+            "sched_bls_items_per_s": sched_r["sched_bls_items_per_s"],
+            "sched_kzg_items_per_s": sched_r["sched_kzg_items_per_s"],
+            "sched_merkle_items_per_s": sched_r["sched_merkle_items_per_s"],
+            "sched_p99_latency_s": sched_r["sched_p99_latency_s"],
+            "sched_occupancy_min": sched_r["sched_occupancy_min"],
+            "sched_compile_s": sched_r["sched_compile_s"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],

@@ -33,6 +33,7 @@ from .hash_to_curve import MAP_TO_CURVE_RFC_COMPLIANT  # noqa: F401
 from ..obs import trace as _obs_trace
 from ..robustness import faults as _faults
 from ..robustness import retry as _retry
+from .. import sched as _sched
 
 bls_active = True
 _backend = "py"
@@ -167,23 +168,21 @@ def _flush_deferred(queue):
     """queue: list of ("kind", args) tuples -> list[bool]."""
     _faults.fire("bls.flush")
     if _backend == "jax":
-        # Imported only on the jax path (ADVICE r5): a pure-Python-oracle
-        # process (no jax installed) must be able to defer, flush, and
-        # clear caches without this module ever being importable.
-        from . import bls_jax
-
-        checks = []
-        results = [None] * len(queue)
-        for i, (kind, args) in enumerate(queue):
-            if kind == "verify":
-                checks.append(bls_jax.make_verify_check(*args))
-            elif kind == "fast_aggregate":
-                checks.append(bls_jax.make_fast_aggregate_check(*args))
-            else:  # aggregate_verify: host fallback (distinct-message multi-pairing)
-                checks.append(None)
-                results[i] = _py.AggregateVerify(*args)
-        dev = bls_jax.run_checks(checks)
-        return [dev[i] if r is None else r for i, r in enumerate(results)]
+        # The device flush is served by the unified verification scheduler
+        # (sched/): one submit per queued check, then a class flush. The
+        # scheduler owns the shape bucketing, the dispatch-seam retry +
+        # breaker, and the per-class metrics; this shim keeps only the
+        # queue semantics. sched is jax-free at module level (ADVICE r5
+        # still holds): device kernels load inside the BLS work class's
+        # execute body, so a pure-Python-oracle process can defer, flush,
+        # and clear caches without jax ever being importable.
+        sch = _sched.default_scheduler()
+        handles = [
+            sch.submit(_sched.Request(work_class="bls", kind=kind,
+                                      payload=args))
+            for kind, args in queue]
+        sch.flush("bls")
+        return [bool(h.result()) for h in handles]
     dispatch = {
         "verify": _py.Verify,
         "fast_aggregate": _py.FastAggregateVerify,

@@ -24,6 +24,7 @@ import numpy as np
 
 from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs_trace
+from ..sched import bucketing as _bucketing
 from . import bls12_381 as oracle
 from .hash_to_curve import hash_to_curve_g2 as _hash_to_curve_g2_uncached
 from .bls12_381 import g2_from_bytes as _g2_from_bytes_uncached
@@ -79,10 +80,7 @@ RLC_MIN_BATCH = 16
 
 
 def _bucket(n: int) -> int:
-    b = _MIN_BATCH
-    while b < n:
-        b *= 2
-    return b
+    return _bucketing.pow2_bucket(n, _MIN_BATCH)
 
 
 def _device_check(p1s, q1s, p2s, q2s) -> np.ndarray:
@@ -308,37 +306,18 @@ def _pack_grouped_args(p1s, q1s, q2s):
     identities by construction: e(G1, Q)·e(−G1, Q) == 1 for ANY G2 point Q,
     so a pad item joining group g uses q1_g as its "signature". The item
     bucket is therefore computed over n + pad_groups, which guarantees
-    pad_items >= pad_groups."""
+    pad_items >= pad_groups. The shape/assignment math lives in
+    sched/bucketing.grouped_plan (shared with the scheduler's lanes); this
+    function only supplies the BLS pad values."""
     from ..ops import bls12_jax as K
 
-    n = len(p1s)
-    gid: dict = {}
-    seg = []
-    reps = []
-    for q1 in q1s:
-        g = gid.get(q1)
-        if g is None:
-            g = gid[q1] = len(reps)
-            reps.append(q1)
-        seg.append(g)
-    d = len(reps)
-    b_d = 1
-    while b_d < d:
-        b_d *= 2
-    pad_groups = b_d - d
-    b_n = _bucket(n + pad_groups)
+    plan = _bucketing.grouped_plan(q1s, _MIN_BATCH)
+    b_n, b_d = plan.b_n, plan.b_d
 
-    p1s = list(p1s)
-    q2s = list(q2s)
-    reps = reps + [_G2] * pad_groups
-    for j in range(b_n - n):
-        if j < pad_groups:
-            g = d + j  # seed each pad group with one valid member
-        else:
-            g = d if pad_groups else 0  # overflow riders join an existing group
-        p1s.append(_G1)
-        q2s.append(reps[g])  # sig := q1_g makes the pad check an identity
-        seg.append(g)
+    reps = [q1s[i] for i in plan.rep_index] + [_G2] * plan.pad_groups
+    p1s = list(p1s) + [_G1] * plan.pad_items
+    # sig := q1_g makes each pad check an identity for its group
+    q2s = list(q2s) + [reps[g] for g in plan.pad_assignments]
 
     import jax.numpy as jnp
     import numpy as np
@@ -349,7 +328,7 @@ def _pack_grouped_args(p1s, q1s, q2s):
     qy = (enc([q[1][0] for q in reps]), enc([q[1][1] for q in reps]))
     q2x = (enc([s[0][0] for s in q2s]), enc([s[0][1] for s in q2s]))
     q2y = (enc([s[1][0] for s in q2s]), enc([s[1][1] for s in q2s]))
-    seg_ids = jnp.asarray(np.array(seg, dtype=np.int32))
+    seg_ids = jnp.asarray(np.array(plan.seg, dtype=np.int32))
     return b_n, b_d, (qx, qy, px, py, q2x, q2y), seg_ids
 
 

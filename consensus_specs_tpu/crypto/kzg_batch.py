@@ -177,7 +177,22 @@ def batch_verify_samples(setup: KZGSetup, items, use_device: bool = True) -> boo
 
     Rejections mirror verify_coset's hostile-input stance: empty/odd ys,
     m beyond the setup, or an identity/malformed proof point reject the
-    batch (never crash)."""
+    batch (never crash).
+
+    Served through the unified verification scheduler (sched/): one
+    request = one whole randomized check, so the all-or-nothing soundness
+    contract is untouched while the dispatch seam adds the shared retry /
+    breaker / metrics wiring and a degraded host-MSM fallback."""
+    from .. import sched as _sched
+
+    sch = _sched.default_scheduler()
+    h = sch.submit(_sched.Request(
+        work_class="kzg", kind="verify_samples",
+        payload=(setup, tuple(items), use_device)))
+    return bool(h.result())
+
+
+def _verify_samples_impl(setup: KZGSetup, items, use_device: bool = True) -> bool:
     items = list(items)
     if not items:
         return True
@@ -222,7 +237,22 @@ def batch_verify_degree_proofs(
     `deg < points_count` (verify_degree_proof, one shared randomized check):
 
         e(Σ r_i·D_i, G2) · e(Σ r_i·(−C_i), [s^(M+1−k)]G2) == 1
+
+    Served through the unified verification scheduler like
+    batch_verify_samples above.
     """
+    from .. import sched as _sched
+
+    sch = _sched.default_scheduler()
+    h = sch.submit(_sched.Request(
+        work_class="kzg", kind="verify_degree_proofs",
+        payload=(setup, tuple(items), points_count, use_device)))
+    return bool(h.result())
+
+
+def _verify_degree_proofs_impl(
+    setup: KZGSetup, items, points_count: int, use_device: bool = True
+) -> bool:
     items = list(items)
     if not items:
         return True

@@ -111,6 +111,27 @@ def _tree_root(chunks: jax.Array) -> jax.Array:
     return nodes[0], depth
 
 
+def _tree_root_batch_impl(chunks: jax.Array) -> jax.Array:
+    """(K, C, 8) chunk words, C a power of two -> (K, 8) per-tree roots.
+
+    The flat adjacent-pair fold of merkle_parent_level never crosses a
+    tree boundary when every tree holds a power-of-two leaf count, so K
+    trees fold as one (K*C, 8) node array: one kernel launch per level
+    for the whole batch. This is the scheduler's Merkle work-class kernel
+    — the scheduler pads K to its pow2 bucket with zero trees and C to a
+    power of two with zero chunks before calling."""
+    k, c, _ = chunks.shape
+    assert c & (c - 1) == 0, "per-tree chunk count must be a power of two"
+    depth = (c - 1).bit_length() if c > 1 else 0
+    nodes = chunks.reshape(k * c, 8)
+    for _ in range(depth):
+        nodes = merkle_parent_level(nodes)
+    return nodes.reshape(k, 8)
+
+
+tree_root_batch = jax.jit(_tree_root_batch_impl)
+
+
 def _extend(root: jax.Array, from_depth: int, to_depth: int) -> jax.Array:
     """Fold the root up to `to_depth` against zero-subtree roots."""
     zw = jnp.asarray(_ZERO_WORDS)

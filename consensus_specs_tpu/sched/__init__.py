@@ -1,0 +1,30 @@
+"""Unified verification scheduler: one shape-bucketed device queue for
+BLS pairing checks, KZG blob/proof batches, and Merkle root folds.
+
+Public surface:
+  * `Request` / `Handle` — the typed submit/future API (api.py)
+  * `Scheduler`, `default_scheduler`, `reset_default_scheduler` — the
+    admission + dispatch engine (scheduler.py)
+  * `bucketing` — the shared pow2 bucket / pad-assignment planner the
+    RLC flush and the scheduler lanes both pack with (bucketing.py)
+  * work classes (classes.py) — the per-lane executors
+
+jax-free at module level: safe to import from the jax-free shim layer
+(crypto/bls.py routes its deferral flush through here).
+"""
+from . import bucketing  # noqa: F401
+from .api import Handle, Request  # noqa: F401
+from .classes import (  # noqa: F401
+    BlsWorkClass,
+    KzgWorkClass,
+    MerkleWorkClass,
+    WorkClass,
+    default_classes,
+)
+from .scheduler import (  # noqa: F401
+    DISPATCH_RETRY_POLICY,
+    SchedResultIntegrityError,
+    Scheduler,
+    default_scheduler,
+    reset_default_scheduler,
+)

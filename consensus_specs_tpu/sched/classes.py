@@ -1,0 +1,268 @@
+"""Work classes served by the verification scheduler.
+
+A work class owns everything lane-specific the scheduler itself must not
+know: how a batch of requests executes on device (`execute`), the
+pure-Python degrade path the circuit breaker falls back to
+(`execute_degraded`), how a result row converts to the caller-facing value
+(`to_result`), the live/padded unit accounting behind the occupancy and
+pad-waste metrics (`load`), and — for classes that opt in — the admission
+collapse hooks (`collapse_key` / `merge`).
+
+Executors return a numpy array with one row per request (bool verdicts for
+BLS/KZG, 32-byte roots for Merkle). The scheduler validates shape and
+dtype after the `sched.dispatch` fault seam, so corrupt-kind chaos faults
+are caught and retried instead of resolving handles with garbage.
+
+jax-free at module level by charter: jax, the device kernels, and the
+heavyweight crypto modules are imported inside the execute bodies only
+(the crypto/bls.py deferral pattern), so jax-free shims can import the
+scheduler without dragging the device stack in.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import bucketing
+from .api import Request
+
+
+class WorkClass:
+    """Base class: one verification lane behind the shared dispatch seam."""
+
+    name = "work"
+    kinds: tuple = ()
+    # per-class queue-depth flush trigger; None defers to the scheduler's
+    # default admission policy
+    max_depth: int | None = None
+    min_bucket = bucketing.MIN_BUCKET
+
+    def execute(self, requests: list) -> np.ndarray:
+        """Device path: one row per request."""
+        raise NotImplementedError
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        """Pure-host fallback the breaker degrades to; must agree with
+        `execute` bit-for-bit on every valid input."""
+        raise NotImplementedError
+
+    def to_result(self, row):
+        return bool(row)
+
+    def load(self, requests: list) -> tuple:
+        """(live_units, padded_units) for the dispatched batch — feeds the
+        sched_batch_occupancy / sched_pad_waste series."""
+        n = len(requests)
+        return n, bucketing.pow2_bucket(n, self.min_bucket)
+
+    # -- admission collapse (off unless a class overrides) -----------------
+
+    def collapse_key(self, request: Request):
+        """Truthy key = this request may merge with queued requests sharing
+        the key into ONE device check. None = never collapse."""
+        return None
+
+    def merge(self, merged: Request, request: Request) -> Request:
+        """Fold `request` into the synthetic collapsed request `merged`;
+        raising aborts the collapse (the request queues individually)."""
+        raise NotImplementedError
+
+
+class BlsWorkClass(WorkClass):
+    """BLS signature checks: the deferral queue's device lane.
+
+    Kinds mirror crypto/bls.py's queue entries: "verify" and
+    "fast_aggregate" become QueuedChecks for the batched RLC flush;
+    "aggregate_verify" (distinct messages per signer) stays on the host
+    oracle exactly as the pre-scheduler flush routed it.
+
+    `collapse_same_message=True` enables the Wonderboom admission policy:
+    same-message fast_aggregate requests merge into one check over the
+    concatenated pubkeys and the aggregated signature (the product of the
+    individual verification equations). The collapsed equation is NOT
+    sound against adversarially chosen signatures without per-request
+    randomization — a forged pair can cancel — so the collapse is opt-in,
+    and a failing collapsed check is re-verified per member for sound
+    attribution before any handle resolves False.
+    """
+
+    name = "bls"
+    kinds = ("verify", "fast_aggregate", "aggregate_verify")
+
+    def __init__(self, collapse_same_message: bool = False):
+        self.collapse_same_message = collapse_same_message
+
+    def execute(self, requests: list) -> np.ndarray:
+        from ..crypto import bls_jax
+        from ..crypto import bls_sig
+
+        checks = []
+        host: dict = {}
+        for i, r in enumerate(requests):
+            if r.kind == "verify":
+                checks.append(bls_jax.make_verify_check(*r.payload))
+            elif r.kind == "fast_aggregate":
+                checks.append(bls_jax.make_fast_aggregate_check(*r.payload))
+            else:  # aggregate_verify: distinct message per signer, host path
+                checks.append(None)
+                host[i] = bool(bls_sig.AggregateVerify(*r.payload))
+        dev = bls_jax.run_checks(checks)
+        return np.asarray(
+            [host[i] if i in host else bool(dev[i])
+             for i in range(len(requests))], dtype=bool)
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        from ..crypto import bls_sig
+
+        dispatch = {
+            "verify": bls_sig.Verify,
+            "fast_aggregate": bls_sig.FastAggregateVerify,
+            "aggregate_verify": bls_sig.AggregateVerify,
+        }
+        return np.asarray(
+            [bool(dispatch[r.kind](*r.payload)) for r in requests],
+            dtype=bool)
+
+    def load(self, requests: list) -> tuple:
+        n = len(requests)
+        msgs = [bytes(r.payload[1]) for r in requests
+                if r.kind in ("verify", "fast_aggregate")]
+        if len(set(msgs)) < len(msgs):
+            # grouped RLC routing: the item bucket covers pad-group seeds
+            plan = bucketing.grouped_plan(msgs, self.min_bucket)
+            return n, n - plan.n + plan.b_n
+        return n, bucketing.pow2_bucket(n, self.min_bucket)
+
+    def collapse_key(self, request: Request):
+        if not self.collapse_same_message:
+            return None
+        if request.kind != "fast_aggregate":
+            return None
+        return ("fast_aggregate", bytes(request.payload[1]))
+
+    def merge(self, merged: Request, request: Request) -> Request:
+        from ..crypto import bls_sig
+
+        pks_a, msg, sig_a = merged.payload
+        pks_b, _, sig_b = request.payload
+        # Aggregate raises on malformed signature bytes -> the scheduler
+        # aborts the collapse and queues the request individually, keeping
+        # admission non-raising for garbage inputs.
+        agg_sig = bls_sig.Aggregate([bytes(sig_a), bytes(sig_b)])
+        return Request(
+            work_class=merged.work_class, kind="fast_aggregate",
+            payload=(list(pks_a) + list(pks_b), msg, agg_sig),
+            group_key=merged.group_key)
+
+
+class KzgWorkClass(WorkClass):
+    """KZG batch lanes: one request = one strict randomized batch check
+    (`crypto/kzg_batch` semantics preserved exactly — the request-level
+    granularity keeps the all-or-nothing soundness contract intact)."""
+
+    name = "kzg"
+    kinds = ("verify_samples", "verify_degree_proofs")
+
+    def execute(self, requests: list) -> np.ndarray:
+        from ..crypto import kzg_batch
+
+        out = []
+        for r in requests:
+            if r.kind == "verify_samples":
+                setup, items, use_device = r.payload
+                out.append(kzg_batch._verify_samples_impl(
+                    setup, items, use_device))
+            else:
+                setup, items, points_count, use_device = r.payload
+                out.append(kzg_batch._verify_degree_proofs_impl(
+                    setup, items, points_count, use_device))
+        return np.asarray(out, dtype=bool)
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        from ..crypto import kzg_batch
+
+        out = []
+        for r in requests:
+            if r.kind == "verify_samples":
+                setup, items, _ = r.payload
+                out.append(kzg_batch._verify_samples_impl(
+                    setup, items, False))
+            else:
+                setup, items, points_count, _ = r.payload
+                out.append(kzg_batch._verify_degree_proofs_impl(
+                    setup, items, points_count, False))
+        return np.asarray(out, dtype=bool)
+
+    def load(self, requests: list) -> tuple:
+        # units are blob/proof items: each request's MSM pads its own item
+        # count to a pow2 bucket inside _device_msm
+        live = padded = 0
+        for r in requests:
+            n = len(r.payload[1])
+            live += n
+            padded += bucketing.pow2_bucket(n, self.min_bucket)
+        return live, padded
+
+
+class MerkleWorkClass(WorkClass):
+    """Batched SSZ chunk-tree roots: kind "tree_root", payload = (chunks,)
+    with chunks a sequence of 32-byte leaves. Trees sharing a leaf count
+    fold in one `engine/state_root.tree_root_batch` launch, padded to the
+    pow2 tree bucket with zero trees (results discarded); the host
+    fallback is the ssz merkleize oracle."""
+
+    name = "merkle"
+    kinds = ("tree_root",)
+
+    def execute(self, requests: list) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine import state_root as SR
+        from ..ops.sha256_jax import words_to_bytes
+
+        out = [None] * len(requests)
+        by_shape: dict = {}
+        for i, r in enumerate(requests):
+            chunks = r.payload[0]
+            c_full = bucketing.pow2_bucket(max(1, len(chunks)), 1)
+            by_shape.setdefault(c_full, []).append(i)
+        for c_full, idxs in sorted(by_shape.items()):
+            k = len(idxs)
+            b_k = bucketing.pow2_bucket(k, 1)
+            words = np.zeros((b_k, c_full, 8), dtype=np.uint32)
+            for row, i in enumerate(idxs):
+                for j, leaf in enumerate(requests[i].payload[0]):
+                    words[row, j] = np.frombuffer(
+                        bytes(leaf), dtype=">u4").astype(np.uint32)
+            roots = np.asarray(jax.device_get(
+                SR.tree_root_batch(jnp.asarray(words))))
+            for row, i in enumerate(idxs):
+                out[i] = np.frombuffer(
+                    words_to_bytes(roots[row]), dtype=np.uint8)
+        return np.asarray(out, dtype=np.uint8)
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        from ..ssz.merkle import merkleize_chunks
+
+        return np.asarray(
+            [np.frombuffer(
+                merkleize_chunks([bytes(c) for c in r.payload[0]]),
+                dtype=np.uint8)
+             for r in requests], dtype=np.uint8)
+
+    def to_result(self, row):
+        return np.asarray(row, dtype=np.uint8).tobytes()
+
+    def load(self, requests: list) -> tuple:
+        # units are whole trees; each leaf-count bucket pads independently
+        by_shape: dict = {}
+        for r in requests:
+            c_full = bucketing.pow2_bucket(max(1, len(r.payload[0])), 1)
+            by_shape[c_full] = by_shape.get(c_full, 0) + 1
+        live = len(requests)
+        padded = sum(bucketing.pow2_bucket(k, 1) for k in by_shape.values())
+        return live, padded
+
+
+def default_classes() -> list:
+    return [BlsWorkClass(), KzgWorkClass(), MerkleWorkClass()]

@@ -1,0 +1,307 @@
+"""Unified verification scheduler: one shape-bucketed device queue.
+
+Every verification lane used to own its batching path — the BLS deferral
+queue (crypto/bls.py), the KZG batch lane (crypto/kzg_batch.py), the
+hashtree folds in engine/ — each with its own pow2 bucketing, its own
+(or no) retry/breaker wiring, and its own metrics vocabulary. This module
+multiplexes them behind one dispatch seam:
+
+  * admission: `submit(Request) -> Handle` appends to a bounded per-class
+    queue. Depth at/over the class bound flushes immediately (backpressure
+    stays bounded without a background thread); an optional deadline
+    flushes any class whose oldest entry has waited too long, checked at
+    every admission. Classes may opt into same-key collapse at admission
+    (the Wonderboom FastAggregateVerify merge — see classes.BlsWorkClass).
+  * dispatch: one batch per class per flush, executed behind the
+    `sched.dispatch` fault seam with the PR-5 retry policy; results are
+    validated (row count + dtype) so corrupt-kind chaos faults retry
+    instead of resolving handles with garbage. Retries always re-enter
+    from intact host payloads — requests carry host bytes, never donated
+    device buffers, so the pre-donation retry invariant holds by
+    construction.
+  * degrade: a dispatch that exhausts retries on a device failure trips
+    the per-class circuit breaker and falls back to the class's
+    pure-Python path. One poisoned lane degrades alone; the other classes
+    keep their device queues.
+  * observability: per-class queue depth, batch occupancy, pad-waste
+    ratio, and submit->result latency histograms (p50/p99 via the
+    registry), plus dispatch/degrade/collapse counters.
+
+jax-free at module level by charter: device work happens inside the work
+classes' execute bodies, behind deferred imports.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+from ..robustness import breaker as _breaker
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
+from .api import Handle, Request
+from .classes import default_classes
+
+# Matches crypto/bls.py's FLUSH_RETRY_POLICY: the seam absorbs the same
+# transient budget the deferral flush always had.
+DISPATCH_RETRY_POLICY = _retry.RetryPolicy(
+    max_attempts=3, base_delay=0.02, max_delay=0.2)
+
+# Admission bound: far above any single epoch's check count, so the depth
+# trigger is backpressure against unbounded producers, not a batch splitter
+# for normal workloads (splitting a flush changes grouped-RLC routing).
+DEFAULT_MAX_DEPTH = 8192
+
+
+class SchedResultIntegrityError(_faults.IntegrityError):
+    """Executor returned a result batch that fails shape/dtype validation
+    (the corrupt-fault detection point). Retryable: request payloads are
+    host-side and intact, so re-execution is safe."""
+
+
+class _Entry:
+    """One queue slot: the requests collapsed into it and their handles."""
+
+    __slots__ = ("members", "handles", "collapsed", "t_submit")
+
+    def __init__(self, request: Request, handle: Handle, now: float):
+        self.members = [request]
+        self.handles = [handle]
+        self.collapsed = request  # the request dispatch actually executes
+        self.t_submit = now
+
+
+class Scheduler:
+    """Shape-bucketed multiplexer for heterogeneous verification work."""
+
+    def __init__(self, classes=None, *, retry_policy=None,
+                 failure_threshold: int = 3,
+                 max_depth: int = DEFAULT_MAX_DEPTH,
+                 flush_deadline_s: float | None = None,
+                 registry=None):
+        self.classes = {wc.name: wc for wc in
+                        (default_classes() if classes is None else classes)}
+        self.retry_policy = retry_policy or DISPATCH_RETRY_POLICY
+        self.max_depth = max_depth
+        self.flush_deadline_s = flush_deadline_s
+        self.registry = registry if registry is not None else _obs_metrics.REGISTRY
+        self._breakers = {
+            name: _breaker.CircuitBreaker(
+                failure_threshold=failure_threshold, name=f"sched-{name}")
+            for name in self.classes}
+        self._queues: dict = {name: [] for name in self.classes}
+        self._collapse_index: dict = {name: {} for name in self.classes}
+        self._lock = threading.RLock()
+
+    # -- admission ---------------------------------------------------------
+
+    def breaker(self, work_class: str) -> _breaker.CircuitBreaker:
+        return self._breakers[work_class]
+
+    def queue_depth(self, work_class: str) -> int:
+        with self._lock:
+            return len(self._queues[work_class])
+
+    def submit(self, request: Request) -> Handle:
+        wc = self.classes.get(request.work_class)
+        if wc is None:
+            raise ValueError(f"unknown work class {request.work_class!r} "
+                             f"(registered: {sorted(self.classes)})")
+        if request.kind not in wc.kinds:
+            raise ValueError(f"unknown kind {request.kind!r} for work class "
+                             f"{wc.name!r} (kinds: {wc.kinds})")
+        now = time.monotonic()
+        handle = Handle(request, self, _submitted_at=now)
+        reg = self.registry
+        with self._lock:
+            depth = self._admit(wc, request, handle, now)
+        reg.counter("sched_submitted_total",
+                    work_class=wc.name, kind=request.kind).inc()
+        reg.gauge("sched_queue_depth", work_class=wc.name).set(depth)
+        limit = wc.max_depth if wc.max_depth is not None else self.max_depth
+        if depth >= limit:
+            self._flush_class(wc.name, trigger="depth")
+        elif self.flush_deadline_s is not None:
+            self._flush_overdue(now)
+        return handle
+
+    def _admit(self, wc, request: Request, handle: Handle, now: float) -> int:
+        """Append (or collapse) under the lock; returns the queue depth."""
+        queue = self._queues[wc.name]
+        key = wc.collapse_key(request)
+        if key is not None:
+            index = self._collapse_index[wc.name]
+            entry = index.get(key)
+            if entry is not None:
+                try:
+                    merged = wc.merge(entry.collapsed, request)
+                except Exception:
+                    merged = None  # unmergeable payload: queue individually
+                if merged is not None:
+                    entry.members.append(request)
+                    entry.handles.append(handle)
+                    entry.collapsed = merged
+                    self.registry.counter(
+                        "sched_collapsed_total", work_class=wc.name).inc()
+                    return len(queue)
+            entry = _Entry(request, handle, now)
+            index[key] = entry
+            queue.append(entry)
+            return len(queue)
+        queue.append(_Entry(request, handle, now))
+        return len(queue)
+
+    def _flush_overdue(self, now: float) -> None:
+        overdue = []
+        with self._lock:
+            for name, queue in self._queues.items():
+                if queue and now - queue[0].t_submit >= self.flush_deadline_s:
+                    overdue.append(name)
+        for name in overdue:
+            self._flush_class(name, trigger="deadline")
+
+    # -- flush / drain -----------------------------------------------------
+
+    def flush(self, work_class: str | None = None) -> None:
+        """Dispatch everything queued (for one class, or all of them)."""
+        names = [work_class] if work_class is not None else list(self.classes)
+        for name in names:
+            self._flush_class(name, trigger="explicit")
+
+    def drain(self) -> None:
+        """Flush until every queue is empty (a flush can enqueue more work
+        through degraded re-verification paths, hence the loop)."""
+        while True:
+            with self._lock:
+                pending = [n for n, q in self._queues.items() if q]
+            if not pending:
+                return
+            for name in pending:
+                self._flush_class(name, trigger="drain")
+
+    def _flush_class(self, name: str, trigger: str) -> None:
+        with self._lock:
+            entries = self._queues[name]
+            if not entries:
+                return
+            self._queues[name] = []
+            self._collapse_index[name] = {}
+        reg = self.registry
+        reg.counter("sched_flush_total", work_class=name,
+                    trigger=trigger).inc()
+        reg.gauge("sched_queue_depth", work_class=name).set(0)
+        self._dispatch(self.classes[name], entries)
+
+    # -- dispatch seam -----------------------------------------------------
+
+    def _dispatch(self, wc, entries: list) -> None:
+        reg = self.registry
+        requests = [e.collapsed for e in entries]
+        brk = self._breakers[wc.name]
+        with _obs_trace.span("sched.dispatch", work_class=wc.name,
+                             batch=len(requests)):
+            mode = brk.on_attempt()
+            n = len(requests)
+
+            def attempt():
+                _faults.fire("sched.dispatch")
+                res = np.asarray(wc.execute(requests))
+                res = _faults.corrupt_array("sched.dispatch", res)
+                return self._validated(res, n, wc.name)
+
+            degraded = False
+            try:
+                policy = (self.retry_policy if mode == "closed"
+                          else _retry.PROBE_POLICY)
+                results = _retry.call_with_retry(attempt, policy)
+                brk.record_success()
+            except Exception as exc:
+                if not _retry.is_device_failure(exc):
+                    for e in entries:
+                        for h in e.handles:
+                            h._fail(exc)
+                    raise
+                brk.record_failure(degraded=True)
+                reg.counter("sched_degraded_total", work_class=wc.name).inc()
+                _obs_trace.annotate(degraded_class=wc.name)
+                results = self._validated(
+                    np.asarray(wc.execute_degraded(requests)), n, wc.name)
+                degraded = True
+
+            live, padded = wc.load(requests)
+            occ = (live / padded) if padded else 1.0
+            reg.counter("sched_dispatch_total", work_class=wc.name,
+                        path="host" if degraded else "device").inc()
+            reg.counter("sched_items_total", work_class=wc.name).inc(live)
+            reg.histogram("sched_batch_occupancy",
+                          buckets=_OCCUPANCY_BUCKETS,
+                          work_class=wc.name).observe(occ)
+            reg.gauge("sched_last_batch_occupancy",
+                      work_class=wc.name).set(occ)
+            reg.gauge("sched_last_pad_waste", work_class=wc.name).set(1 - occ)
+            self._resolve(wc, entries, results, degraded)
+
+    def _resolve(self, wc, entries: list, results, degraded: bool) -> None:
+        lat = self.registry.histogram(
+            "sched_submit_latency_seconds", work_class=wc.name)
+        now = time.monotonic()
+        for e, row in zip(entries, results):
+            if len(e.members) > 1 and not wc.to_result(row):
+                # a failing collapsed check proves nothing about members:
+                # re-verify each for sound attribution (Wonderboom fallback)
+                self.registry.counter("sched_collapse_reverify_total",
+                                      work_class=wc.name).inc()
+                runner = wc.execute_degraded if degraded else wc.execute
+                member_rows = self._validated(
+                    np.asarray(runner(e.members)), len(e.members), wc.name)
+                for h, mrow in zip(e.handles, member_rows):
+                    lat.observe(max(0.0, now - h._submitted_at))
+                    h._resolve(wc.to_result(mrow))
+                continue
+            value = wc.to_result(row)
+            for h in e.handles:
+                lat.observe(max(0.0, now - h._submitted_at))
+                h._resolve(value)
+
+    def _validated(self, res: np.ndarray, n: int, name: str) -> np.ndarray:
+        arr = np.asarray(res)
+        if arr.ndim == 0 or arr.shape[0] != n or arr.dtype.kind == "f":
+            raise SchedResultIntegrityError(
+                f"sched.dispatch[{name}]: executor returned "
+                f"shape={arr.shape} dtype={arr.dtype} for {n} requests")
+        return arr
+
+
+# Occupancy is a ratio in [0, 1]; the default latency-shaped buckets would
+# collapse every observation into the top decades.
+_OCCUPANCY_BUCKETS = tuple(i / 16 for i in range(1, 17))
+
+
+# -- process-default instance ---------------------------------------------
+#
+# The BLS deferral flush and the KZG batch entry points route through one
+# shared scheduler so heterogeneous submitters actually share queues (the
+# point of the subsystem). Tests that inject faults or trip breakers build
+# their own instances, or reset this one to avoid cross-test state.
+
+_DEFAULT: Scheduler | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_scheduler() -> Scheduler:
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Scheduler()
+    return _DEFAULT
+
+
+def reset_default_scheduler() -> None:
+    """Drop the process-default instance (fresh queues and breakers)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None

@@ -1,0 +1,8 @@
+"""Seeded failure shape: a scheduler module importing the device stack at
+module level — every jax-free submitter (crypto/bls.py's deferral flush,
+the KZG batch entry points) would drag jax in just by queueing work."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def dispatch(batch):
+    return jax.device_get(batch)

@@ -1,0 +1,18 @@
+"""Clean scheduler module: jax-free at module level, device work deferred
+into the executor body — the sched/scheduler.py charter (work classes load
+jax inside execute(), so shims submit without importing the device stack)."""
+
+pending = []
+
+
+def submit(request):
+    pending.append(request)
+    return len(pending) - 1
+
+
+def dispatch(batch, use_device=False):
+    if use_device:
+        import jax  # deferred: only the device path pays
+
+        return jax.device_get(batch)
+    return list(batch)

@@ -34,6 +34,12 @@ from consensus_specs_tpu.robustness.faults import (
     uninstall,
 )
 from consensus_specs_tpu.robustness.retry import RetryPolicy
+from consensus_specs_tpu.sched import (
+    KzgWorkClass,
+    MerkleWorkClass,
+    Request,
+    Scheduler,
+)
 from consensus_specs_tpu.ssz import hash_tree_root
 from consensus_specs_tpu.testlib.state import prepared_epoch_state
 
@@ -416,3 +422,105 @@ def test_chaos_aux_corruption_is_validated_not_consumed(spec):
                 eng._flush_pending()
         eng._pending = None  # discard the poisoned segment for the next round
         eng._deferred_epochs = 0
+
+
+# --- the scheduler dispatch seam (sched.dispatch) ----------------------------
+#
+# Same contract as the engine seams above, at the verification scheduler's
+# single device boundary: injected raises are absorbed by the dispatch
+# retry, injected corruption is caught by result validation and re-executed
+# from intact host payloads, and a hard-down class degrades to its
+# pure-Python path ALONE — with results bit-identical to the fault-free
+# oracle in every case.
+
+
+def _merkle_requests():
+    """Deterministic tree workload spanning several leaf-count buckets."""
+    reqs = []
+    for i, n_chunks in enumerate((1, 3, 8, 5, 16, 2)):
+        chunks = [bytes([17 * i + j + 1] * 32) for j in range(n_chunks)]
+        reqs.append(Request(work_class="merkle", kind="tree_root",
+                            payload=(chunks,)))
+    return reqs
+
+
+def _run_sched_merkle(expect_closed=True):
+    sch = Scheduler(classes=[MerkleWorkClass()], retry_policy=FAST_RETRY)
+    handles = [sch.submit(r) for r in _merkle_requests()]
+    sch.drain()
+    roots = [h.result() for h in handles]
+    if expect_closed:
+        assert sch.breaker("merkle").state == "closed"
+    return roots
+
+
+def test_chaos_sched_dispatch_converges_bit_identical():
+    """Raise + corrupt kinds at sched.dispatch: every run's roots are
+    byte-identical to the fault-free oracle, and absorbed faults never
+    trip the breaker (retries re-enter from intact host payloads)."""
+    oracle = _run_sched_merkle()
+    schedules = (
+        dict(kind="raise", at_calls=(1, 2), exc="transient"),
+        dict(kind="raise", at_calls=(1,), exc="xla"),
+        dict(kind="corrupt", at_calls=(1,), corruption="nan"),
+        dict(kind="corrupt", at_calls=(1,), corruption="truncate"),
+    )
+    for kw in schedules:
+        plan = FaultPlan(seed=11, sites={"sched.dispatch": FaultSpec(**kw)})
+        with plan.active():
+            roots = _run_sched_merkle()
+        assert roots == oracle
+        assert plan.fired_sites() == {"sched.dispatch"}
+
+
+def test_chaos_sched_breaker_degrades_only_faulted_class():
+    """A hard-down dispatch exhausts the retry budget, opens the FAULTED
+    class's breaker, and serves that batch from the pure-Python path —
+    while the other class's breaker stays closed and its requests keep
+    verifying. Degraded results still match the fault-free oracle."""
+    from consensus_specs_tpu.crypto import das, kzg
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    setup = kzg.insecure_test_setup(32)
+    data = [pow(5, 3 * i + 1, kzg.MODULUS) for i in range(8)]
+    commitment, samples = das.sample_data(setup, data, 4, use_device=False)
+    cosets = das.sample_cosets(16, 4)
+    kzg_items = tuple(
+        (commitment, cosets[s.index][0], list(s.values), s.proof)
+        for s in samples)
+
+    def fresh():
+        return Scheduler(classes=[MerkleWorkClass(), KzgWorkClass()],
+                         retry_policy=FAST_RETRY, failure_threshold=1)
+
+    oracle_roots = [
+        h.result() for h in
+        [fresh().submit(r) for r in _merkle_requests()]]
+
+    sch = fresh()
+    plan = FaultPlan(seed=5, sites={
+        "sched.dispatch": FaultSpec(kind="raise", rate=1.0,
+                                    max_fires=FAST_RETRY.max_attempts,
+                                    exc="transient"),
+    })
+    reg = obs_metrics.REGISTRY
+    degraded_before = {
+        cls: reg.counter_value("sched_degraded_total", work_class=cls)
+        for cls in ("merkle", "kzg")}
+    with plan.active():
+        mh = [sch.submit(r) for r in _merkle_requests()]
+        sch.flush("merkle")  # every retry attempt faults -> host degrade
+        roots = [h.result() for h in mh]
+        kh = sch.submit(Request(
+            work_class="kzg", kind="verify_samples",
+            payload=(setup, kzg_items, False)))
+        assert kh.result() is True  # fault budget spent: kzg lane clean
+    assert roots == oracle_roots
+    assert plan.fires("sched.dispatch") == FAST_RETRY.max_attempts
+    assert sch.breaker("merkle").state == "open"
+    assert sch.breaker("kzg").state == "closed"
+    degraded = {
+        cls: reg.counter_value("sched_degraded_total", work_class=cls)
+        - degraded_before[cls]
+        for cls in ("merkle", "kzg")}
+    assert degraded == {"merkle": 1, "kzg": 0}

@@ -393,6 +393,45 @@ def test_obs_dump_check_and_render(tmp_path):
     assert "fault_fires_total" in res.stdout and "histogram" in res.stdout
 
 
+def test_obs_dump_table_groups_by_subsystem_prefix(tmp_path):
+    """Table mode groups series under [prefix] headers (sched_*, bls_*,
+    fault_*, ...) in sorted group order, with canonical counter -> gauge ->
+    histogram ordering preserved inside each group — pinned against the
+    canonical snapshot so a renderer regression reorders loudly."""
+    r = _populated_registry()
+    r.counter("sched_submitted_total", work_class="bls", kind="verify").inc(4)
+    r.gauge("sched_queue_depth", work_class="bls").set(2)
+    r.histogram("sched_submit_latency_seconds", work_class="bls").observe(0.01)
+    r.counter("gossip_rx_total", topic="attestation").inc(7)
+    path = tmp_path / "snap.json"
+    obs_export.write_snapshot(path, r, meta={"lane": "test"})
+    res = _run_dump("table", str(path))
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.splitlines()
+    headers = [ln for ln in lines if ln.startswith("[")]
+    assert headers == ["[bls]", "[fault]", "[gossip]", "[retries]",
+                       "[sched]", "[span]"]
+
+    def block(header):
+        start = lines.index(header) + 1
+        out = []
+        for ln in lines[start:]:
+            if not ln.startswith("  "):
+                break
+            out.append(ln.split()[0])
+        return out
+
+    assert block("[sched]") == [
+        'sched_submitted_total{kind="verify",work_class="bls"}',
+        'sched_queue_depth{work_class="bls"}',
+        'sched_submit_latency_seconds{work_class="bls"}',
+    ]
+    assert block("[gossip]") == ['gossip_rx_total{topic="attestation"}']
+    # every series line is indented under some group header
+    body = [ln for ln in lines if ln and not ln.startswith(("[", "meta:"))]
+    assert all(ln.startswith("  ") for ln in body)
+
+
 def test_obs_dump_check_fails_loudly_on_corruption(tmp_path):
     path = tmp_path / "snap.json"
     path.write_text('{"version":1}\n')

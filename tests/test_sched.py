@@ -1,0 +1,321 @@
+"""Unified verification scheduler (consensus_specs_tpu/sched/).
+
+The subsystem's contracts, each pinned here:
+
+  * bucketing — the pow2 bucket / grouped pad-assignment math extracted
+    from crypto/bls_jax._pack_grouped_args keeps that packer's exact
+    arithmetic (tests/test_rlc_grouped.py pins the packer itself; this
+    file pins the shared planner the packer now delegates to);
+  * admission — futures resolve lazily, depth and deadline triggers
+    flush bounded queues, same-key collapse merges at admission with
+    sound per-member attribution on a failing collapsed check;
+  * dispatch — per-class breaker isolation and result validation are
+    covered by tests/test_chaos_epoch.py; here: the compile-cache pin
+    (fixed bucket set => one XLA compile per (class, bucket)) and the
+    occupancy/pad-waste metrics the SLO table reports;
+  * lanes — the Merkle class agrees bit-for-bit with the host ssz
+    oracle, and the public KZG batch entry points actually route
+    through the scheduler.
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.obs import metrics as obs_metrics
+from consensus_specs_tpu.sched import (
+    BlsWorkClass,
+    MerkleWorkClass,
+    Request,
+    Scheduler,
+    WorkClass,
+    bucketing,
+)
+
+REG = obs_metrics.REGISTRY
+
+
+# --- bucketing (satellite of the bls_jax extraction) -------------------------
+
+
+def test_pow2_bucket_floor_and_growth():
+    assert bucketing.pow2_bucket(0) == 8
+    assert bucketing.pow2_bucket(8) == 8
+    assert bucketing.pow2_bucket(9) == 16
+    assert bucketing.pow2_bucket(3, 1) == 4
+    assert bucketing.pow2_bucket(1, 1) == 1
+
+
+def test_pad_plan_occupancy():
+    p = bucketing.pad_plan(5)
+    assert (p.bucket, p.pad) == (8, 3)
+    assert p.occupancy == 5 / 8 and p.pad_waste == 3 / 8
+
+
+def test_grouped_plan_matches_rlc_packer_arithmetic():
+    """The exact n=10/d=5 pin tests/test_rlc_grouped.py puts on
+    _pack_grouped_args, stated on the shared planner: (b_n, b_d) = (16, 8),
+    live items first, pad seeds for groups 5..7, riders joining group 5."""
+    plan = bucketing.grouped_plan([0, 0, 1, 1, 2, 2, 3, 3, 4, 4])
+    assert (plan.n, plan.d, plan.b_n, plan.b_d) == (10, 5, 16, 8)
+    assert plan.pad_groups == 3 and plan.pad_items == 6
+    assert plan.seg[:10] == (0, 0, 1, 1, 2, 2, 3, 3, 4, 4)
+    assert plan.pad_assignments == (5, 6, 7, 5, 5, 5)
+    assert plan.rep_index == (0, 2, 4, 6, 8)
+    assert plan.seg == plan.seg[:10] + plan.pad_assignments
+
+
+def test_grouped_plan_pow2_distinct_riders_join_group_zero():
+    plan = bucketing.grouped_plan(list(range(4)))
+    assert (plan.d, plan.b_d, plan.pad_groups) == (4, 4, 0)
+    assert plan.b_n == 8
+    assert plan.pad_assignments == (0, 0, 0, 0)
+
+
+def test_grouped_plan_keys_compared_by_value():
+    a1, a2 = (1, (2, 3)), (1, (2, 3))  # equal, distinct objects
+    plan = bucketing.grouped_plan([a1, a2, (9, ())])
+    assert plan.d == 2
+
+
+# --- admission: futures, backpressure, collapse ------------------------------
+
+
+class EchoClass(WorkClass):
+    """Host-only stub: result = payload[0]; records dispatched batch sizes."""
+
+    name = "echo"
+    kinds = ("echo",)
+
+    def __init__(self):
+        self.batches = []
+
+    def execute(self, requests):
+        self.batches.append(len(requests))
+        return np.asarray([bool(r.payload[0]) for r in requests], dtype=bool)
+
+    def execute_degraded(self, requests):
+        return self.execute(requests)
+
+
+def _echo(value=True):
+    return Request(work_class="echo", kind="echo", payload=(value,))
+
+
+def test_submit_returns_pending_handle_and_result_flushes():
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc])
+    h = sch.submit(_echo(True))
+    assert not h.done() and wc.batches == []
+    assert h.result() is True  # result() flushes the owning class lazily
+    assert h.done() and wc.batches == [1]
+
+
+def test_unknown_class_and_kind_reject_at_admission():
+    sch = Scheduler(classes=[EchoClass()])
+    with pytest.raises(ValueError, match="unknown work class"):
+        sch.submit(Request(work_class="nope", kind="echo", payload=()))
+    with pytest.raises(ValueError, match="unknown kind"):
+        sch.submit(Request(work_class="echo", kind="nope", payload=()))
+
+
+def test_depth_trigger_flushes_bounded_queue():
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc], max_depth=4)
+    before = REG.counter_value("sched_flush_total", work_class="echo",
+                               trigger="depth")
+    handles = [sch.submit(_echo()) for _ in range(6)]
+    assert wc.batches == [4]  # admission flushed at the depth bound
+    assert all(h.done() for h in handles[:4])
+    assert not handles[5].done()
+    sch.drain()
+    assert wc.batches == [4, 2]
+    assert all(h.done() for h in handles)
+    after = REG.counter_value("sched_flush_total", work_class="echo",
+                              trigger="depth")
+    assert after - before == 1
+
+
+def test_deadline_trigger_flushes_overdue_queue():
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc], flush_deadline_s=0.0)
+    h1 = sch.submit(_echo())
+    assert h1.done()  # zero deadline: overdue at the very next admission
+    assert wc.batches == [1]
+
+
+class CollapsibleEcho(EchoClass):
+    """Same-key requests merge; the merged payload ANDs the members, so a
+    bad member fails the collapsed check (like an aggregated signature)."""
+
+    def collapse_key(self, request):
+        return request.payload[1]
+
+    def merge(self, merged, request):
+        return Request(work_class=self.name, kind="echo",
+                       payload=(merged.payload[0] and request.payload[0],
+                                merged.payload[1]))
+
+
+def _keyed(value, key):
+    return Request(work_class="echo", kind="echo", payload=(value, key))
+
+
+def test_collapse_merges_same_key_and_fans_out():
+    wc = CollapsibleEcho()
+    sch = Scheduler(classes=[wc])
+    before = REG.counter_value("sched_collapsed_total", work_class="echo")
+    hs = [sch.submit(_keyed(True, "m1")) for _ in range(3)]
+    other = sch.submit(_keyed(True, "m2"))
+    sch.drain()
+    assert wc.batches == [2]  # 3 collapsed + 1 distinct = 2 device checks
+    assert all(h.result() is True for h in hs) and other.result() is True
+    assert REG.counter_value("sched_collapsed_total",
+                             work_class="echo") - before == 2
+
+
+def test_collapse_failure_reverifies_members_for_attribution():
+    """A failing collapsed check proves nothing about members: each is
+    re-verified individually, so the one bad request resolves False and
+    the good riders still resolve True (the Wonderboom fallback)."""
+    wc = CollapsibleEcho()
+    sch = Scheduler(classes=[wc])
+    before = REG.counter_value("sched_collapse_reverify_total",
+                               work_class="echo")
+    good1 = sch.submit(_keyed(True, "m"))
+    bad = sch.submit(_keyed(False, "m"))
+    good2 = sch.submit(_keyed(True, "m"))
+    sch.drain()
+    assert good1.result() is True and good2.result() is True
+    assert bad.result() is False
+    assert wc.batches == [1, 3]  # collapsed check, then per-member pass
+    assert REG.counter_value("sched_collapse_reverify_total",
+                             work_class="echo") - before == 1
+
+
+class HostBlsClass(BlsWorkClass):
+    """BLS class pinned to the pure-Python oracle: exercises the real
+    collapse_key/merge (pubkey concat + signature aggregation) without
+    paying a device pairing compile in the fast tier."""
+
+    def execute(self, requests):
+        return self.execute_degraded(requests)
+
+
+def test_bls_same_message_collapse_end_to_end():
+    from consensus_specs_tpu.crypto import bls_sig
+
+    msg, other_msg = b"sched collapse msg", b"sched other msg"
+    sks = [101, 202, 303]
+    pks = [bls_sig.SkToPk(sk) for sk in sks]
+    sigs = [bls_sig.Sign(sk, msg) for sk in sks]
+
+    wc = HostBlsClass(collapse_same_message=True)
+    sch = Scheduler(classes=[wc])
+    hs = [sch.submit(Request(work_class="bls", kind="fast_aggregate",
+                             payload=([pk], msg, sig)))
+          for pk, sig in zip(pks, sigs)]
+    # wrong-message signature shares the collapse key but must not poison
+    # the two honest requests: attribution re-verifies per member
+    bad = sch.submit(Request(
+        work_class="bls", kind="fast_aggregate",
+        payload=([pks[0]], msg, bls_sig.Sign(sks[0], other_msg))))
+    sch.drain()
+    assert [h.result() for h in hs] == [True, True, True]
+    assert bad.result() is False
+
+
+def test_bls_collapse_is_opt_in():
+    wc = BlsWorkClass()  # default: no collapse
+    assert wc.collapse_key(Request(
+        work_class="bls", kind="fast_aggregate",
+        payload=([b"\x22" * 48], b"m", b"\x11" * 96))) is None
+
+
+# --- lanes: Merkle device/host agreement, KZG routing ------------------------
+
+
+def _tree_requests(counts, tag=0):
+    reqs = []
+    for i, n_chunks in enumerate(counts):
+        chunks = [bytes([(7 * tag + 13 * i + j) % 251 + 1] * 32)
+                  for j in range(n_chunks)]
+        reqs.append(Request(work_class="merkle", kind="tree_root",
+                            payload=(chunks,)))
+    return reqs
+
+
+def test_merkle_class_matches_ssz_oracle():
+    from consensus_specs_tpu.ssz.merkle import merkleize_chunks
+
+    reqs = _tree_requests((1, 2, 3, 8, 5))
+    sch = Scheduler(classes=[MerkleWorkClass()])
+    handles = [sch.submit(r) for r in reqs]
+    sch.drain()
+    for r, h in zip(reqs, handles):
+        root = h.result()
+        assert isinstance(root, bytes) and len(root) == 32
+        assert root == merkleize_chunks([bytes(c) for c in r.payload[0]])
+
+
+def test_kzg_batch_entry_points_route_through_scheduler():
+    """The public crypto/kzg_batch functions are served by the default
+    scheduler's kzg class — pinned via the admission counter so a future
+    refactor can't silently fork the lane back out."""
+    from consensus_specs_tpu.crypto import kzg, kzg_batch
+
+    before = REG.counter_value("sched_submitted_total", work_class="kzg",
+                               kind="verify_samples")
+    setup = kzg.insecure_test_setup(8)
+    assert kzg_batch.batch_verify_samples(setup, [], use_device=False)
+    after = REG.counter_value("sched_submitted_total", work_class="kzg",
+                              kind="verify_samples")
+    assert after - before == 1
+
+
+# --- compile-cache pin + occupancy SLO ---------------------------------------
+
+
+def test_merkle_compile_pinned_one_per_bucket():
+    """Fixed bucket set => one XLA compile per (class, bucket): replaying
+    the same tree-count bucket reuses the cached executable; only a new
+    bucket compiles. Verified with the PR-6 CompileTracker, per the
+    acceptance criterion."""
+    from consensus_specs_tpu.obs.recompile import CompileTracker
+
+    kernel = "_tree_root_batch_impl"
+    tracker = CompileTracker(registry=obs_metrics.MetricsRegistry()).install()
+    try:
+        sch = Scheduler(classes=[MerkleWorkClass()])
+        base = tracker.compiles(kernel)
+
+        def run(counts, tag):
+            hs = [sch.submit(r) for r in _tree_requests(counts, tag)]
+            sch.drain()
+            return [h.result() for h in hs]
+
+        # chunk counts (3, 2, 3) -> shape groups (2, 4, 8) and (1, 2, 8)
+        run((3, 2, 3), tag=1)
+        first = tracker.compiles(kernel) - base
+        assert first >= 1
+        run((3, 2, 3), tag=2)  # same buckets, different data: cache hits
+        assert tracker.compiles(kernel) - base == first
+        run((3,) * 14, tag=3)  # 14 trees -> (16, 4, 8): one new compile
+        assert tracker.compiles(kernel) - base == first + 1
+        assert tracker.distinct_shapes(kernel) == first + 1
+    finally:
+        tracker.uninstall()
+
+
+def test_occupancy_and_pad_waste_metrics():
+    """14 trees in a 16-tree bucket: occupancy 0.875 (>= the 0.75 SLO),
+    pad waste 0.125 — from the same gauges the bench lane reports."""
+    sch = Scheduler(classes=[MerkleWorkClass()])
+    handles = [sch.submit(r) for r in _tree_requests((4,) * 14, tag=9)]
+    sch.drain()
+    assert all(h.done() for h in handles)
+    occ = REG.gauge_value("sched_last_batch_occupancy", work_class="merkle")
+    waste = REG.gauge_value("sched_last_pad_waste", work_class="merkle")
+    assert occ == 14 / 16 >= 0.75
+    assert waste == pytest.approx(2 / 16)
+    # submit->result latency histogram populated for the class
+    h = REG.histogram("sched_submit_latency_seconds", work_class="merkle")
+    assert h.count >= 14 and h.p99() >= h.p50() >= 0.0

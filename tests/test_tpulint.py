@@ -95,8 +95,10 @@ def test_layering_fixture():
     assert "prod.py" in by_file  # non-test -> testlib/
     assert "bad_faults.py" in by_file  # robustness/ module-level jax
     assert "bad_hooks.py" in by_file  # obs/ module-level jax.monitoring
+    assert "bad_dispatch.py" in by_file  # sched/ module-level jax
     for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
-                  "recompile.py"):  # recompile: obs install-deferral pattern
+                  "recompile.py",  # recompile: obs install-deferral pattern
+                  "queue.py"):  # sched: executor-deferral pattern
         assert clean not in by_file
 
 

@@ -87,7 +87,31 @@ def check_e2e_lane() -> int:
     print(f"# bench-probe: e2e lane present "
           f"(e2e={extra['bls_verify_throughput_e2e']}/s over "
           f"{extra['rlc_distinct_messages']} distinct messages)", file=sys.stderr)
+    rc = check_sched_lane(extra)
+    if rc:
+        return rc
     return check_obs_snapshot()
+
+
+def check_sched_lane(extra: dict) -> int:
+    """Refuse a record without the unified-scheduler mixed lane: the
+    occupancy floor (sched_occupancy_min) is the guard that the shared
+    bucketing still packs batches instead of padding them away, and the
+    per-class throughputs are the evidence that BLS/KZG/Merkle really run
+    through one seam. A bench that silently dropped the lane would read
+    as 'scheduler still fine' while measuring nothing."""
+    missing = [k for k in ("sched_occupancy_min", "sched_bls_items_per_s",
+                           "sched_kzg_items_per_s", "sched_merkle_items_per_s")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the unified "
+              f"scheduler mixed lane (missing {missing}); fix "
+              f"benches/sched_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: sched lane present "
+          f"(occupancy_min={extra['sched_occupancy_min']})", file=sys.stderr)
+    return 0
 
 
 def check_obs_snapshot() -> int:

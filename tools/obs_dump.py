@@ -81,23 +81,43 @@ def cmd_prom(path: str) -> int:
     return 0
 
 
+def _subsystem(series_key: str) -> str:
+    """Grouping prefix of a series key: the first `_`-delimited token of
+    the metric name (`sched_queue_depth{...}` -> `sched`). Series whose
+    name has no underscore group under the whole name."""
+    name = series_key.split("{", 1)[0]
+    return name.split("_", 1)[0]
+
+
 def cmd_table(path: str) -> int:
+    """Human-oriented summary, grouped by subsystem prefix so the lanes a
+    snapshot covers (sched_*, bls_*, gossip_*, fault_*, ...) read as
+    blocks instead of one interleaved flat list. Within a group, rows
+    keep canonical order: counters, then gauges, then histograms, each
+    sorted by series key."""
     snap = _load(path)
     rows = []
     for key, v in sorted(snap.get("counters", {}).items()):
-        rows.append((key, "counter", f"{v:g}"))
+        rows.append((_subsystem(key), key, "counter", f"{v:g}"))
     for key, v in sorted(snap.get("gauges", {}).items()):
-        rows.append((key, "gauge", f"{v:g}"))
+        rows.append((_subsystem(key), key, "gauge", f"{v:g}"))
     for key, h in sorted(snap.get("histograms", {}).items()):
-        rows.append((key, "histogram",
+        rows.append((_subsystem(key), key, "histogram",
                      f"count={h['count']} sum={h['sum']:.6g} "
                      f"p50={h['p50']:.6g} p99={h['p99']:.6g}"))
     if not rows:
         print("(empty snapshot)")
         return 0
-    width = max(len(r[0]) for r in rows)
-    for key, kind, val in rows:
-        print(f"{key:<{width}}  {kind:<9}  {val}")
+    width = max(len(r[1]) for r in rows)
+    by_group: dict = {}
+    for group, key, kind, val in rows:
+        by_group.setdefault(group, []).append((key, kind, val))
+    for i, group in enumerate(sorted(by_group)):
+        if i:
+            print()
+        print(f"[{group}]")
+        for key, kind, val in by_group[group]:
+            print(f"  {key:<{width}}  {kind:<9}  {val}")
     if "meta" in snap:
         print(f"\nmeta: {snap['meta']}")
     return 0
