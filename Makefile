@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding
 
-.PHONY: test testall citest testfast chaos sched lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched firehose lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -61,6 +61,18 @@ sched:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_sched.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_sched.json
+
+# Attestation firehose lane: the streaming gossip->aggregate->flush
+# service (ingest dedup, committee collapse, double-buffered flush,
+# backpressure) plus the gossip driver's partial-drain seam it consumes —
+# see README "Attestation firehose". Obs snapshot validated like the
+# chaos/sched lanes; the firehose_* series are the artifact.
+firehose:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_firehose.json OBS_SNAPSHOT_LANE=firehose \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_firehose.py tests/test_gossip_driver.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_firehose.json
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

@@ -224,6 +224,10 @@ def run_benches() -> dict:
             import benches.sched_bench as sched_bench
 
             sched_r = sched_bench.run()
+        with timed("bench_firehose"):
+            import benches.firehose_bench as firehose_bench
+
+            fh_r = firehose_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -306,6 +310,17 @@ def run_benches() -> dict:
             "sched_p99_latency_s": sched_r["sched_p99_latency_s"],
             "sched_occupancy_min": sched_r["sched_occupancy_min"],
             "sched_compile_s": sched_r["sched_compile_s"],
+            # attestation firehose soak: streaming gossip->aggregate->flush
+            # throughput at 64 committees/slot sized for a 1M-validator
+            # registry, p99 ingest->verified from the pipeline's own
+            # histogram, and the committee-collapse ratio (atts per
+            # device pairing check)
+            "firehose_atts_per_s_cold": fh_r["firehose_atts_per_s_cold"],
+            "firehose_atts_per_s_steady": fh_r["firehose_atts_per_s_steady"],
+            "firehose_p99_ingest_to_verified_s":
+                fh_r["firehose_p99_ingest_to_verified_s"],
+            "firehose_collapse_ratio": fh_r["firehose_collapse_ratio"],
+            "firehose_queue_depth_peak": fh_r["firehose_queue_depth_peak"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],

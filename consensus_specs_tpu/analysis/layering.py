@@ -35,7 +35,7 @@ class LayeringConfig:
     # path patterns (see core.path_matches) that must stay jax-free at import
     jax_free: tuple[str, ...] = (
         "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
-        "crypto/das.py", "robustness/", "obs/", "sched/",
+        "crypto/das.py", "robustness/", "obs/", "sched/", "firehose/",
     )
     # (importer pattern, forbidden import pattern) over module paths
     forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)

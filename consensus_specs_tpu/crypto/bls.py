@@ -177,10 +177,9 @@ def _flush_deferred(queue):
         # execute body, so a pure-Python-oracle process can defer, flush,
         # and clear caches without jax ever being importable.
         sch = _sched.default_scheduler()
-        handles = [
-            sch.submit(_sched.Request(work_class="bls", kind=kind,
-                                      payload=args))
-            for kind, args in queue]
+        handles = sch.submit_many([
+            _sched.Request(work_class="bls", kind=kind, payload=args)
+            for kind, args in queue])
         sch.flush("bls")
         return [bool(h.result()) for h in handles]
     dispatch = {
@@ -278,6 +277,7 @@ def clear_caches() -> None:
     import sys
 
     clear_sign_cache()
+    _py.clear_sig_point_cache()
     bls_jax = sys.modules.get(__package__ + ".bls_jax")
     if bls_jax is None:
         return

@@ -11,6 +11,8 @@ conformance BLS vectors demand.
 """
 from __future__ import annotations
 
+from functools import lru_cache
+
 from . import bls12_381 as c
 from .hash_to_curve import hash_to_curve_g2
 
@@ -57,12 +59,30 @@ def Verify(pubkey: bytes, message: bytes, signature: bytes) -> bool:
     return c.multi_pairing([(neg_g1, sig), (pk, h)]) == c.F12_ONE
 
 
+@lru_cache(maxsize=1 << 16)
+def _sig_point_memo(signature: bytes):
+    """Decompressed, subgroup-checked G2 point for one compressed signature.
+
+    Decompression pays an Fp2 sqrt plus a full scalar-mul subgroup check;
+    a streaming aggregation workload (the attestation firehose) decodes
+    the same committee signatures on every re-sighting, so the memo turns
+    the dominant admission cost into a dict hit. Pure and deterministic
+    (points are nested int tuples), bounded so an adversarial stream of
+    unique garbage cannot grow it without bound; ValueErrors are not
+    cached by lru_cache, so malformed bytes keep raising."""
+    return c.g2_from_bytes(bytes(signature))
+
+
+def clear_sig_point_cache() -> None:
+    _sig_point_memo.cache_clear()
+
+
 def Aggregate(signatures) -> bytes:
     if len(signatures) == 0:
         raise ValueError("Aggregate requires at least one signature")
     acc = None
     for s in signatures:
-        pt = c.g2_from_bytes(bytes(s))
+        pt = _sig_point_memo(bytes(s))
         acc = c.pt_add(c.FP2_FIELD, acc, c.pt_from_affine(c.FP2_FIELD, pt))
     return c.g2_to_bytes(c.pt_to_affine(c.FP2_FIELD, acc))
 

@@ -1,0 +1,28 @@
+"""Attestation firehose: streaming gossip→aggregate→flush verification.
+
+The slot-barrier gossip path (GossipNode.drain_and_verify) batches a whole
+slot's messages and verifies them in one deferred flush. This package turns
+that into a resident streaming service for million-validator scale: an
+ingest stage that consumes gossip rx incrementally, a committee-keyed
+aggregation tree that collapses same-committee attestations into one
+FastAggregateVerify per committee through the scheduler's admission hooks,
+and a double-buffered flush stage that overlaps host-side packing of the
+next batch with the in-flight device dispatch — all under a hard
+backpressure bound. See pipeline.AttestationFirehose.
+
+jax-free at module level by charter (tpulint import-layering): device work
+happens only behind sched/'s work-class execute bodies.
+"""
+from .ingest import AttestationItem, ClassifyError, beacon_classifier
+from .oracle import slot_barrier_oracle
+from .pipeline import AttestationFirehose, FirehoseConfig, FirehoseKilled
+
+__all__ = [
+    "AttestationFirehose",
+    "AttestationItem",
+    "ClassifyError",
+    "FirehoseConfig",
+    "FirehoseKilled",
+    "beacon_classifier",
+    "slot_barrier_oracle",
+]

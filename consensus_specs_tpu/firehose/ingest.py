@@ -1,0 +1,80 @@
+"""Ingest-stage building blocks: payload → keyed AttestationItem.
+
+The firehose keys every attestation by (slot, committee_index,
+beacon_block_root) — the committee identity Wonderboom-style aggregation
+collapses on. A classifier turns one raw gossip payload (ssz bytes) into
+an AttestationItem carrying that key plus everything verification needs
+(participant pubkeys, signing root, aggregate signature); the pipeline
+itself never decodes ssz or touches spec objects, so classifiers are
+injected: `beacon_classifier(spec, state)` for real spec Attestations,
+plain closures for synthetic bench/test traffic.
+
+jax-free at module level by charter; spec objects arrive pre-built from
+the caller and are only touched inside the classifier closure.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..parallel.gossip_driver import message_id
+
+
+class ClassifyError(ValueError):
+    """Payload failed decode/keying: quarantined by ingest, never verified
+    (and never forwarded to the oracle either — both sides must agree on
+    what a malformed payload is)."""
+
+
+@dataclass(frozen=True)
+class AttestationItem:
+    """One gossip attestation after decode, keyed for committee collapse."""
+
+    msg_id: bytes     # 20-byte gossip message-id (the dedup identity)
+    key: tuple        # (slot, committee_index, beacon_block_root)
+    pubkeys: tuple    # compressed pubkeys of the attesting participants
+    message: bytes    # signing root every participant signed
+    signature: bytes  # aggregate signature over `message`
+    ssz: bytes        # raw payload; retry/restore re-enter from host bytes
+
+
+def beacon_classifier(spec, state):
+    """classifier(ssz_bytes) -> AttestationItem for real spec Attestations.
+
+    Decodes the payload, resolves the attesting committee against `state`,
+    and derives the signing root — the exact inputs
+    spec.is_valid_indexed_attestation hands to bls.FastAggregateVerify, so
+    a firehose verdict equals the spec's signature verdict for the same
+    payload. Any decode/keying failure raises ClassifyError (quarantine),
+    matching how the gossip driver treats undecodable frames.
+    """
+
+    def classify(ssz_bytes: bytes) -> AttestationItem:
+        raw = bytes(ssz_bytes)
+        try:
+            att = spec.Attestation.decode_bytes(raw)
+            data = att.data
+            indexed = spec.get_indexed_attestation(state, att)
+            indices = list(indexed.attesting_indices)
+            if not indices:
+                raise ValueError("attestation has no participants")
+            domain = spec.get_domain(
+                state, spec.DOMAIN_BEACON_ATTESTER, data.target.epoch)
+            signing_root = bytes(spec.compute_signing_root(data, domain))
+            pubkeys = tuple(
+                bytes(state.validators[i].pubkey) for i in indices)
+        except ClassifyError:
+            raise
+        except Exception as exc:
+            raise ClassifyError(
+                f"attestation decode/keying failed: "
+                f"{type(exc).__name__}: {exc}") from exc
+        return AttestationItem(
+            msg_id=message_id(raw),
+            key=(int(data.slot), int(data.index),
+                 bytes(data.beacon_block_root)),
+            pubkeys=pubkeys,
+            message=signing_root,
+            signature=bytes(att.signature),
+            ssz=raw)
+
+    return classify

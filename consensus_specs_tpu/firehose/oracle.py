@@ -1,0 +1,30 @@
+"""Slot-barrier pure-Python oracle: the firehose correctness reference.
+
+Replays the exact ingest semantics — message-id dedup, classifier keying,
+quarantine of malformed payloads — but verifies every attestation
+INDIVIDUALLY with the pure-Python BLS oracle: no collapse, no device, no
+batching, no threads. The streamed, collapsed, double-buffered firehose
+answer must be bit-identical to this for every seeded scenario, including
+chaos schedules and mid-stream kill/restore (the chaos-reconciliation
+gate in tests/test_firehose.py).
+"""
+from __future__ import annotations
+
+from ..crypto import bls_sig
+from .ingest import ClassifyError
+
+
+def slot_barrier_oracle(payloads, classifier) -> dict:
+    """{msg_id: bool} over the deduplicated stream; malformed payloads are
+    quarantined exactly as the firehose ingest stage quarantines them."""
+    results: dict = {}
+    for ssz in payloads:
+        try:
+            item = classifier(bytes(ssz))
+        except ClassifyError:
+            continue
+        if item.msg_id in results:
+            continue
+        results[item.msg_id] = bool(bls_sig.FastAggregateVerify(
+            list(item.pubkeys), item.message, item.signature))
+    return results

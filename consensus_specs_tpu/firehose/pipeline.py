@@ -1,0 +1,453 @@
+"""The resident streaming pipeline: ingest → aggregate → flush.
+
+Three stages behind one object, replacing the slot-barrier batch path with
+a continuous service:
+
+  ingest     offer()/offer_many()/ingest_from() consume gossip rx
+             incrementally (GossipNode.drain_ready — no slot barrier),
+             deduplicate by gossip message-id over a bounded FIFO window,
+             and classify each payload into an AttestationItem keyed by
+             (slot, committee_index, beacon_block_root). Malformed
+             payloads quarantine exactly like the gossip driver's decode
+             failures. Fault seam: `firehose.ingest`.
+
+  aggregate  admitted items become fast_aggregate Requests submitted in
+             one batched admission pass (Scheduler.submit_many) through a
+             collapse-enabled BlsWorkClass: the scheduler's admission tree
+             merges every same-committee attestation into ONE
+             FastAggregateVerify entry, so each committee costs one
+             pairing at dispatch, before the grouped-RLC flush even
+             starts. A failing collapsed check re-verifies per member
+             inside sched for sound attribution (Wonderboom fallback).
+             Fault seam: `firehose.aggregate`.
+
+  flush      a dedicated worker seals batches (size or deadline) and
+             dispatches them via Scheduler.flush. While batch N holds the
+             device, producers keep packing batch N+1 into the fresh
+             scheduler queue — double buffering at batch granularity, the
+             host-side packing of N+1 overlapping N's in-flight dispatch.
+             Fault seam: `firehose.flush`. A fatal fault kills the worker
+             mid-stream; restore() resumes from intact host payloads.
+
+Backpressure contract: at most `config.max_pending` attestations sit
+between ingest and verified at any instant. At the bound, producers defer
+(block, counted in firehose_deferrals_total) until the device drains, or
+— with drop_overflow, or when nothing can drain the queue — shed the
+overflow (counted in firehose_dropped_total, dedup entries released so a
+re-offer can succeed). `firehose_queue_depth` can therefore never grow
+without bound, and its high-water mark is `firehose_queue_depth_peak`.
+
+Degradation reuses the PR-5/PR-8 machinery wholesale: every stage retries
+transients through robustness.retry, and the device dispatch itself sits
+behind the scheduler's per-class breaker, which degrades an exhausted BLS
+lane to the pure-Python oracle path.
+
+jax-free at module level by charter (tpulint import-layering): device work
+happens only inside the scheduler's work-class execute bodies.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
+from ..sched import BlsWorkClass, Request, Scheduler
+from .ingest import ClassifyError
+
+# Stage-local transient budget, matching the scheduler's dispatch seam.
+STAGE_RETRY_POLICY = _retry.RetryPolicy(
+    max_attempts=3, base_delay=0.01, max_delay=0.1)
+
+# The firehose seals its own batches; its scheduler must never depth-flush
+# inline on a producer thread (that would serialize packing with dispatch).
+_NEVER_DEPTH_FLUSH = 1 << 30
+
+
+class FirehoseKilled(RuntimeError):
+    """The flush stage died on a non-retryable fault; restore() resumes
+    from intact host payloads."""
+
+
+@dataclass(frozen=True)
+class FirehoseConfig:
+    batch_attestations: int = 1024  # seal a flush batch at this many members
+    max_pending: int = 2048         # ingest→verified bound (two sealed batches)
+    flush_deadline_s: float = 0.05  # seal a non-empty batch after this long
+    backpressure_wait_s: float = 0.2  # one deferral wait quantum at the bound
+    drop_overflow: bool = False     # True: shed at the bound instead of deferring
+    dedup_capacity: int = 1 << 20   # message-id FIFO window (evictions counted)
+
+    def __post_init__(self):
+        if self.batch_attestations < 1:
+            raise ValueError("batch_attestations must be >= 1")
+        if self.max_pending < self.batch_attestations:
+            raise ValueError("max_pending must cover at least one batch")
+
+
+class AttestationFirehose:
+    """One resident gossip→aggregate→flush service instance.
+
+    `classifier(ssz_bytes) -> AttestationItem` is injected (see
+    ingest.beacon_classifier); `threaded=False` runs the flush stage
+    inline on the producer thread — deterministic for exact-schedule chaos
+    tests, at the cost of the packing/dispatch overlap.
+    """
+
+    def __init__(self, classifier, *, config: FirehoseConfig | None = None,
+                 scheduler: Scheduler | None = None, registry=None,
+                 retry_policy: _retry.RetryPolicy | None = None,
+                 threaded: bool = True):
+        self.classifier = classifier
+        self.config = config or FirehoseConfig()
+        self.registry = (registry if registry is not None
+                         else _obs_metrics.REGISTRY)
+        self.retry_policy = retry_policy or STAGE_RETRY_POLICY
+        if scheduler is None:
+            scheduler = Scheduler(
+                classes=[BlsWorkClass(collapse_same_message=True)],
+                max_depth=_NEVER_DEPTH_FLUSH, registry=self.registry)
+        self.scheduler = scheduler
+        self.threaded = threaded
+        self._lock = threading.Lock()
+        self._sealed = threading.Condition(self._lock)  # producers -> worker
+        self._room = threading.Condition(self._lock)    # worker -> producers
+        self._seen: dict = {}       # msg_id -> None, insertion-ordered FIFO
+        self._awaiting: list = []   # (msg_id, key, handle, t_ingest)
+        self._dead: list = []       # records whose handle failed (restore())
+        self._results: dict = {}    # msg_id -> bool
+        self._pending = 0           # members between ingest and verified
+        self._peak = 0
+        self._seal = False
+        self._stop = False
+        self._failure: BaseException | None = None
+        self._worker: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "AttestationFirehose":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    def start(self) -> "AttestationFirehose":
+        if not self.threaded:
+            return self
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return self
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._flush_loop, name="firehose-flush", daemon=True)
+        self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if drain and self._failure is None:
+            self.drain()
+        with self._lock:
+            self._stop = True
+            self._sealed.notify_all()
+        worker = self._worker
+        if worker is not None:
+            worker.join(timeout=30.0)
+        self._worker = None
+
+    # -- stage 1: ingest ---------------------------------------------------
+
+    def ingest_from(self, node, max_messages: int | None = None) -> int:
+        """Pull whatever the gossip driver has buffered (drain_ready — the
+        pre-slot-barrier partial drain) and ingest it. Returns the number
+        of newly admitted attestations."""
+        return self.offer_many(node.drain_ready(max_messages))
+
+    def offer(self, ssz_bytes: bytes) -> bool:
+        """Ingest one payload; True iff admitted (False: duplicate,
+        malformed, or shed under backpressure)."""
+        return self.offer_many([ssz_bytes]) == 1
+
+    def offer_many(self, payloads) -> int:
+        """Ingest a micro-batch: classify/dedup each payload, then admit
+        the survivors through one batched aggregation pass."""
+        items = []
+        for ssz in payloads:
+            item = self._ingest_one(bytes(ssz))
+            if item is not None:
+                items.append(item)
+        return self._aggregate_many(items)
+
+    def _ingest_one(self, raw: bytes):
+        reg = self.registry
+        with _obs_trace.span("firehose.ingest"):
+
+            def attempt():
+                _faults.fire("firehose.ingest")
+                return self.classifier(raw)
+
+            try:
+                item = _retry.call_with_retry(attempt, self.retry_policy)
+            except ClassifyError:
+                reg.counter("firehose_malformed_total").inc()
+                return None
+            with self._lock:
+                if item.msg_id in self._seen:
+                    reg.counter("firehose_duplicates_total").inc()
+                    return None
+                self._seen[item.msg_id] = None
+                if len(self._seen) > self.config.dedup_capacity:
+                    self._seen.pop(next(iter(self._seen)))
+                    reg.counter("firehose_dedup_evictions_total").inc()
+            reg.counter("firehose_ingested_total").inc()
+            return item
+
+    # -- stage 2: committee-keyed aggregation ------------------------------
+
+    def _aggregate_many(self, items: list) -> int:
+        """Admit items progressively: as much as fits under max_pending is
+        submitted immediately (so the flush stage always has work it can
+        drain), the remainder waits for room — never the whole batch at
+        once, or a batch wider than the bound could deadlock against an
+        idle worker."""
+        if not items:
+            return 0
+        cfg = self.config
+        reg = self.registry
+        admitted = 0
+        with _obs_trace.span("firehose.aggregate", batch=len(items)):
+            while items:
+                with self._lock:
+                    room = cfg.max_pending - self._pending
+                    while room <= 0:
+                        can_defer = (self.threaded and not cfg.drop_overflow
+                                     and self._failure is None
+                                     and self._worker is not None
+                                     and self._worker.is_alive())
+                        if not can_defer:
+                            for it in items:
+                                # release dedup so a later re-offer can land
+                                self._seen.pop(it.msg_id, None)
+                            reg.counter("firehose_dropped_total").inc(
+                                len(items))
+                            return admitted
+                        reg.counter("firehose_deferrals_total").inc()
+                        self._seal = True
+                        self._sealed.notify_all()
+                        self._room.wait(timeout=cfg.backpressure_wait_s)
+                        room = cfg.max_pending - self._pending
+                    chunk, items = items[:room], items[room:]
+                    self._pending += len(chunk)
+                    if self._pending > self._peak:
+                        self._peak = self._pending
+                        reg.gauge("firehose_queue_depth_peak").set(self._peak)
+                    reg.gauge("firehose_queue_depth").set(self._pending)
+
+                def attempt(chunk=chunk):
+                    _faults.fire("firehose.aggregate")
+                    return self.scheduler.submit_many([
+                        Request(work_class="bls", kind="fast_aggregate",
+                                payload=(list(it.pubkeys), it.message,
+                                         it.signature),
+                                group_key=it.key)
+                        for it in chunk])
+
+                try:
+                    handles = _retry.call_with_retry(
+                        attempt, self.retry_policy)
+                except BaseException:
+                    with self._lock:
+                        self._pending -= len(chunk)
+                        for it in chunk + items:
+                            self._seen.pop(it.msg_id, None)
+                        reg.gauge("firehose_queue_depth").set(self._pending)
+                        self._room.notify_all()
+                    raise
+                now = time.monotonic()
+                reg.counter("firehose_submitted_total").inc(len(chunk))
+                admitted += len(chunk)
+                with self._lock:
+                    for it, h in zip(chunk, handles):
+                        self._awaiting.append((it.msg_id, it.key, h, now))
+                    if self._pending >= cfg.batch_attestations:
+                        self._seal = True
+                        self._sealed.notify_all()
+                    run_inline = self._seal and not self.threaded
+                    if run_inline:
+                        self._seal = False
+                if run_inline:
+                    self._flush_once("depth")
+        return admitted
+
+    # -- stage 3: double-buffered flush ------------------------------------
+
+    def _flush_loop(self) -> None:
+        cfg = self.config
+        while True:
+            with self._lock:
+                # idle: block until there is anything to do
+                while (not self._stop and not self._seal
+                       and self._pending == 0):
+                    self._sealed.wait(timeout=1.0)
+                if self._pending == 0:
+                    if self._stop:
+                        return
+                    self._seal = False
+                    continue
+                # work pending: give producers up to the flush deadline to
+                # fill the batch, then seal whatever is there
+                deadline = time.monotonic() + cfg.flush_deadline_s
+                while not self._stop and not self._seal:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._sealed.wait(timeout=remaining)
+                trigger = ("drain" if self._stop
+                           else "depth" if self._seal else "deadline")
+                self._seal = False
+            try:
+                self._flush_once(trigger)
+            except BaseException as exc:
+                with self._lock:
+                    self._failure = exc
+                    self._room.notify_all()
+                self.registry.counter("firehose_kills_total").inc()
+                return
+
+    def _flush_once(self, trigger: str) -> None:
+        reg = self.registry
+        entries, members = self.scheduler.queue_load("bls")
+        with _obs_trace.span("firehose.flush", trigger=trigger,
+                             committees=entries, attestations=members):
+            if entries:
+                reg.gauge("firehose_collapse_ratio").set(
+                    round(members / entries, 4))
+                reg.gauge("firehose_batch_committees").set(entries)
+
+            def attempt():
+                _faults.fire("firehose.flush")
+                self.scheduler.flush("bls", trigger="stream")
+                return True
+
+            _retry.call_with_retry(attempt, self.retry_policy)
+            reg.counter("firehose_flush_total", trigger=trigger).inc()
+            self._collect()
+
+    def _collect(self) -> None:
+        """Resolve every finished handle: record the verdict and the
+        ingest→verified latency, free backpressure room. Handles that
+        FAILED (a non-device executor error leaked through the scheduler)
+        park in self._dead for restore() to resubmit — they still hold
+        their intact host payloads."""
+        reg = self.registry
+        lat = reg.histogram("firehose_ingest_to_verified_seconds")
+        now = time.monotonic()
+        verified = rejected = 0
+        first_error = None
+        with self._lock:
+            still: list = []
+            done: list = []
+            for rec in self._awaiting:
+                handle = rec[2]
+                if handle._error is not None:
+                    self._dead.append(rec)
+                    first_error = first_error or handle._error
+                elif handle.done():
+                    done.append(rec)
+                else:
+                    still.append(rec)
+            self._awaiting = still
+            self._pending -= len(done)
+            for msg_id, _key, handle, t_ingest in done:
+                ok = bool(handle.result())
+                self._results[msg_id] = ok
+                lat.observe(max(0.0, now - t_ingest))
+                verified += ok
+                rejected += not ok
+            reg.gauge("firehose_queue_depth").set(self._pending)
+            self._room.notify_all()
+        if verified:
+            reg.counter("firehose_verified_total").inc(verified)
+        if rejected:
+            reg.counter("firehose_rejected_total").inc(rejected)
+        if first_error is not None:
+            raise FirehoseKilled(
+                "flush resolved handles with executor errors; restore() "
+                "will resubmit them") from first_error
+
+    # -- drain / kill / restore --------------------------------------------
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        """Block until every admitted attestation has a verdict."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if self._failure is not None:
+                    raise FirehoseKilled(
+                        "flush worker died; call restore()"
+                    ) from self._failure
+                if self._pending == 0:
+                    return
+                worker_alive = (self._worker is not None
+                                and self._worker.is_alive())
+                if worker_alive:
+                    self._seal = True
+                    self._sealed.notify_all()
+                    self._room.wait(timeout=0.1)
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            f"firehose drain: {self._pending} attestations "
+                            "still pending")
+                    continue
+            # inline mode (or the worker was never started)
+            self._flush_once("drain")
+
+    def restore(self) -> "AttestationFirehose":
+        """Resume after a mid-stream kill. Host payloads and the scheduler
+        queue both survive a worker death intact, so recovery is:
+        resubmit any member whose handle died, restart the worker, seal."""
+        with self._lock:
+            self._failure = None
+            dead, self._dead = self._dead, []
+        if dead:
+            handles = self.scheduler.submit_many(
+                [rec[2].request for rec in dead])
+            with self._lock:
+                for rec, handle in zip(dead, handles):
+                    self._awaiting.append((rec[0], rec[1], handle, rec[3]))
+        self.registry.counter("firehose_restores_total").inc()
+        if self.threaded:
+            with self._lock:
+                if self._worker is not None and not self._worker.is_alive():
+                    self._worker = None
+            self.start()
+        with self._lock:
+            if self._pending:
+                self._seal = True
+                self._sealed.notify_all()
+        return self
+
+    # -- results -----------------------------------------------------------
+
+    @property
+    def failure(self) -> BaseException | None:
+        return self._failure
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def peak_depth(self) -> int:
+        with self._lock:
+            return self._peak
+
+    def results(self) -> dict:
+        """{msg_id: bool} snapshot of every resolved attestation."""
+        with self._lock:
+            return dict(self._results)
+
+    def verified_ids(self) -> set:
+        with self._lock:
+            return {m for m, ok in self._results.items() if ok}

@@ -150,6 +150,7 @@ class NodeStats:
     received: int = 0
     duplicates: int = 0
     verified_batches: int = 0
+    partial_drains: int = 0  # drain_ready() calls that returned messages
     malformed: int = 0  # frames/messages quarantined instead of delivered
     message_ids: set = field(default_factory=set)
     # (reason, payload head) of recent malformed frames — enough to
@@ -254,6 +255,25 @@ class GossipNode:
             wire = encode_message(ssz)
             for link in self._links:
                 send_frame(link, wire)
+
+    def drain_ready(self, max_messages: int | None = None) -> list[bytes]:
+        """Non-blocking partial drain for streaming consumers (the
+        attestation firehose): pop up to `max_messages` verified-candidate
+        payloads that already cleared framing, decode, and message-id
+        dedup — WITHOUT waiting for the slot barrier and without
+        verifying. Interleaves freely with `drain_and_verify`, which keeps
+        its exact batch semantics over whatever remains buffered: every
+        message is returned by exactly one drain call, whichever kind
+        claims it first."""
+        with self._lock:
+            if max_messages is None:
+                batch, self.inbox = self.inbox, []
+            else:
+                batch = self.inbox[:max_messages]
+                del self.inbox[:max_messages]
+            if batch:
+                self.stats.count("partial_drains")
+        return batch
 
     def drain_and_verify(self, verify_fn) -> int:
         """Verify everything collected so far in one deferred-BLS flush."""

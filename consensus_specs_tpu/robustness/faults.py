@@ -1,16 +1,19 @@
 """Seeded, deterministic fault injection at the device-boundary seams.
 
-The resident pipeline crosses five trust boundaries where real deployments
+The resident pipeline crosses six trust boundaries where real deployments
 fail: the XLA dispatch (tunnel drops, preemptions), the EpochAux host
 readout (torn or corrupted D2H copies), the registry write-back (a crash
 mid-reconstruction), the gossip wire (truncated frames from a dying
-peer), and the verification scheduler's dispatch (`sched.dispatch` — the
-seam every BLS/KZG/Merkle batch crosses in sched/scheduler.py). A
+peer), the verification scheduler's dispatch (`sched.dispatch` — the
+seam every BLS/KZG/Merkle batch crosses in sched/scheduler.py), and the
+attestation firehose's three stages (`firehose.ingest`,
+`firehose.aggregate`, `firehose.flush` — the streaming
+gossip→aggregate→flush pipeline in firehose/pipeline.py). A
 `FaultPlan` injects failures at exactly those seams — the hooks live in
 the PRODUCTION code paths (engine/bridge.py, engine/resident.py,
-parallel/gossip_driver.py, crypto/bls.py, sched/scheduler.py), not in
-test mocks, so the chaos suite exercises the same retry/validate/degrade
-machinery a live node runs.
+parallel/gossip_driver.py, crypto/bls.py, sched/scheduler.py,
+firehose/pipeline.py), not in test mocks, so the chaos suite exercises
+the same retry/validate/degrade machinery a live node runs.
 
 Determinism: every site draws from its OWN `random.Random` stream keyed by
 (plan seed, site name), so the fire schedule of one site is independent of

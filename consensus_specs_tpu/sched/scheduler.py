@@ -105,6 +105,15 @@ class Scheduler:
         with self._lock:
             return len(self._queues[work_class])
 
+    def queue_load(self, work_class: str) -> tuple:
+        """(entries, members) currently queued: distinct device checks vs
+        the requests collapsed into them. members/entries is the live
+        collapse ratio a streaming consumer (the attestation firehose)
+        reports before it seals a batch."""
+        with self._lock:
+            queue = self._queues[work_class]
+            return len(queue), sum(len(e.members) for e in queue)
+
     def submit(self, request: Request) -> Handle:
         wc = self.classes.get(request.work_class)
         if wc is None:
@@ -127,6 +136,96 @@ class Scheduler:
         elif self.flush_deadline_s is not None:
             self._flush_overdue(now)
         return handle
+
+    def submit_many(self, requests: list) -> list:
+        """Admit a batch of requests under ONE lock acquisition.
+
+        Semantics match a submit() loop (same collapse behaviour, counters,
+        and depth/deadline triggers evaluated after admission), with one
+        batch-level improvement: same-collapse-key groups fold through the
+        class's `merge_group` hook when it defines one, so a committee's
+        worth of same-message signatures aggregates in a single pass
+        instead of a chain of pairwise merges. The depth trigger fires at
+        most once per class AFTER the whole batch is admitted — a batched
+        producer wants one sealed flush, not a flush per boundary crossing.
+        """
+        if not requests:
+            return []
+        now = time.monotonic()
+        handles: list[Handle] = []
+        per_class: dict = {}
+        for request in requests:
+            wc = self.classes.get(request.work_class)
+            if wc is None:
+                raise ValueError(f"unknown work class {request.work_class!r} "
+                                 f"(registered: {sorted(self.classes)})")
+            if request.kind not in wc.kinds:
+                raise ValueError(f"unknown kind {request.kind!r} for work "
+                                 f"class {wc.name!r} (kinds: {wc.kinds})")
+            handle = Handle(request, self, _submitted_at=now)
+            handles.append(handle)
+            per_class.setdefault(wc.name, []).append((request, handle))
+        reg = self.registry
+        depths: dict = {}
+        with self._lock:
+            for name, pairs in per_class.items():
+                depths[name] = self._admit_batch(
+                    self.classes[name], pairs, now)
+        for name, pairs in per_class.items():
+            for request, _ in pairs:
+                reg.counter("sched_submitted_total",
+                            work_class=name, kind=request.kind).inc()
+            reg.gauge("sched_queue_depth", work_class=name).set(depths[name])
+            wc = self.classes[name]
+            limit = wc.max_depth if wc.max_depth is not None else self.max_depth
+            if depths[name] >= limit:
+                self._flush_class(name, trigger="depth")
+        if self.flush_deadline_s is not None:
+            self._flush_overdue(time.monotonic())
+        return handles
+
+    def _admit_batch(self, wc, pairs: list, now: float) -> int:
+        """Admit (request, handle) pairs for one class under the held lock."""
+        groups: dict = {}
+        for request, handle in pairs:
+            key = wc.collapse_key(request)
+            if key is None:
+                self._admit(wc, request, handle, now)
+            else:
+                groups.setdefault(key, []).append((request, handle))
+        for key, members in groups.items():
+            self._admit_group(wc, key, members, now)
+        return len(self._queues[wc.name])
+
+    def _admit_group(self, wc, key, members: list, now: float) -> None:
+        """Collapse one same-key group in a single merge_group pass; any
+        class without the hook — or a group whose aggregation rejects a
+        payload — falls back to the pairwise _admit path, which isolates
+        the unmergeable request instead of poisoning the group."""
+        merge_group = getattr(wc, "merge_group", None)
+        entry = self._collapse_index[wc.name].get(key)
+        if merge_group is not None and (entry is not None or len(members) > 1):
+            base = entry.collapsed if entry is not None else members[0][0]
+            rest = members if entry is not None else members[1:]
+            try:
+                merged = merge_group(base, [r for r, _ in rest])
+            except Exception:
+                merged = None  # unmergeable payload somewhere: isolate below
+            if merged is not None:
+                if entry is None:
+                    request, handle = members[0]
+                    entry = _Entry(request, handle, now)
+                    self._collapse_index[wc.name][key] = entry
+                    self._queues[wc.name].append(entry)
+                for request, handle in rest:
+                    entry.members.append(request)
+                    entry.handles.append(handle)
+                    self.registry.counter(
+                        "sched_collapsed_total", work_class=wc.name).inc()
+                entry.collapsed = merged
+                return
+        for request, handle in members:
+            self._admit(wc, request, handle, now)
 
     def _admit(self, wc, request: Request, handle: Handle, now: float) -> int:
         """Append (or collapse) under the lock; returns the queue depth."""
@@ -165,11 +264,14 @@ class Scheduler:
 
     # -- flush / drain -----------------------------------------------------
 
-    def flush(self, work_class: str | None = None) -> None:
-        """Dispatch everything queued (for one class, or all of them)."""
+    def flush(self, work_class: str | None = None, *,
+              trigger: str = "explicit") -> None:
+        """Dispatch everything queued (for one class, or all of them).
+        `trigger` only labels the sched_flush_total series — streaming
+        callers (the firehose worker) tag their flushes distinctly."""
         names = [work_class] if work_class is not None else list(self.classes)
         for name in names:
-            self._flush_class(name, trigger="explicit")
+            self._flush_class(name, trigger=trigger)
 
     def drain(self) -> None:
         """Flush until every queue is empty (a flush can enqueue more work

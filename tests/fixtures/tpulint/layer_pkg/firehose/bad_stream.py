@@ -1,0 +1,9 @@
+"""Seeded failure shape: a firehose stage importing the device stack at
+module level — the streaming service is a pure host-side orchestrator
+(submit/flush through sched/), so a module-level jax import here would
+drag the device stack into every gossip consumer."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def flush(batch):
+    return jax.device_get(batch)

@@ -1,0 +1,20 @@
+"""Clean firehose stage: jax-free at module level, matching the
+firehose/pipeline.py charter — device work happens only behind the
+scheduler's work-class execute bodies, and any direct device touch is
+deferred into the branch that needs it."""
+
+queue = []
+
+
+def offer(payload):
+    queue.append(payload)
+    return len(queue)
+
+
+def flush(use_device=False):
+    batch, queue[:] = list(queue), []
+    if use_device:
+        import jax  # deferred: only the device path pays
+
+        return jax.device_get(batch)
+    return batch

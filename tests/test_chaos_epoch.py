@@ -35,6 +35,7 @@ from consensus_specs_tpu.robustness.faults import (
 )
 from consensus_specs_tpu.robustness.retry import RetryPolicy
 from consensus_specs_tpu.sched import (
+    BlsWorkClass,
     KzgWorkClass,
     MerkleWorkClass,
     Request,
@@ -524,3 +525,57 @@ def test_chaos_sched_breaker_degrades_only_faulted_class():
         - degraded_before[cls]
         for cls in ("merkle", "kzg")}
     assert degraded == {"merkle": 1, "kzg": 0}
+
+
+def test_chaos_sched_collapse_reverify_attribution():
+    """The collapse path under sched.dispatch chaos: raise + corrupt
+    faults on the COLLAPSED same-message BLS batch are absorbed by the
+    retry/validation loop, and the failing collapsed check (poisoned by
+    one wrong-key member) still re-verifies per member with sound
+    attribution — the honest member passes, only the forger rejects, and
+    sched_collapse_reverify_total ticks exactly once per run."""
+    from consensus_specs_tpu.crypto import bls_sig
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    class HostBls(BlsWorkClass):
+        """Pinned to the pure-Python path: real collapse_key/merge G2
+        arithmetic without a device pairing compile in the fast tier."""
+
+        def execute(self, requests):
+            return self.execute_degraded(requests)
+
+    msg = b"collapse chaos msg"
+    honest_sk, forger_sk = 61, 62
+    payloads = [
+        ([bls_sig.SkToPk(honest_sk)], msg, bls_sig.Sign(honest_sk, msg)),
+        # valid G2 point, wrong key: shares the collapse key, fails alone
+        ([bls_sig.SkToPk(forger_sk)], msg, bls_sig.Sign(forger_sk + 1, msg)),
+    ]
+    reg = obs_metrics.REGISTRY
+
+    def run():
+        sch = Scheduler(classes=[HostBls(collapse_same_message=True)],
+                        retry_policy=FAST_RETRY)
+        hs = [sch.submit(Request(work_class="bls", kind="fast_aggregate",
+                                 payload=p)) for p in payloads]
+        sch.drain()
+        assert sch.breaker("bls").state == "closed"
+        return [h.result() for h in hs]
+
+    assert run() == [True, False]  # fault-free oracle
+
+    schedules = (
+        dict(kind="raise", at_calls=(1, 2), exc="transient"),
+        dict(kind="raise", at_calls=(1,), exc="xla"),
+        dict(kind="corrupt", at_calls=(1,), corruption="nan"),
+        dict(kind="corrupt", at_calls=(1,), corruption="truncate"),
+    )
+    for kw in schedules:
+        before = reg.counter_value("sched_collapse_reverify_total",
+                                   work_class="bls")
+        plan = FaultPlan(seed=31, sites={"sched.dispatch": FaultSpec(**kw)})
+        with plan.active():
+            assert run() == [True, False]
+        assert plan.fired_sites() == {"sched.dispatch"}
+        assert reg.counter_value("sched_collapse_reverify_total",
+                                 work_class="bls") - before == 1

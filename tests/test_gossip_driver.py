@@ -284,3 +284,49 @@ def test_message_id_v2_is_topic_bound():
         + len(t_altair).to_bytes(8, "little") + t_altair + junk
     ).digest()[:20]
     assert message_id_v2(t_altair, junk) == expected_inv
+
+
+def test_interleaved_partial_and_full_drains():
+    """drain_ready (streaming partial drain) interleaves freely with
+    drain_and_verify (slot-barrier batch): every message is claimed by
+    exactly one drain call, the batch path's semantics are unchanged for
+    whatever remains buffered, and only non-empty partial drains tick the
+    partial_drains stat."""
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        node = GossipNode(0, BASE_PORT + 80, [])
+        try:
+            payloads = [b"stream-msg-%d" % i for i in range(7)]
+            node.publish(payloads)  # no links: seeds the local inbox
+
+            first = node.drain_ready(max_messages=2)
+            assert first == payloads[:2]
+            assert node.stats.partial_drains == 1
+
+            # the slot-barrier path sees exactly the remainder, in order
+            seen = []
+            assert node.drain_and_verify(seen.append) == 5
+            assert seen == payloads[2:]
+            assert node.stats.verified_batches == 1
+
+            # both drain kinds find an empty buffer; no stat ticks
+            assert node.drain_ready() == []
+            assert node.drain_and_verify(seen.append) == 0
+            assert node.stats.partial_drains == 1
+            assert node.stats.verified_batches == 1
+
+            # refill: unbounded partial drain claims everything at once
+            node.publish([b"second-wave-%d" % i for i in range(3)])
+            assert len(node.drain_ready()) == 3
+            assert node.stats.partial_drains == 2
+            assert node.drain_and_verify(seen.append) == 0
+
+            # dedup is shared across drain kinds: re-publishing an already
+            # drained payload is absorbed before either drain sees it
+            node.publish(payloads[:1])
+            assert node.drain_ready() == []
+        finally:
+            node.close()
+    finally:
+        bls.bls_active = prev

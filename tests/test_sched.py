@@ -319,3 +319,126 @@ def test_occupancy_and_pad_waste_metrics():
     # submit->result latency histogram populated for the class
     h = REG.histogram("sched_submit_latency_seconds", work_class="merkle")
     assert h.count >= 14 and h.p99() >= h.p50() >= 0.0
+
+
+# --- batched admission: submit_many / queue_load -----------------------------
+
+
+class GroupCollapsibleEcho(CollapsibleEcho):
+    """CollapsibleEcho plus the batched collapse hook submit_many prefers:
+    the whole same-key group merges in one call (vs one merge per member)."""
+
+    def __init__(self):
+        super().__init__()
+        self.group_merges = []
+
+    def merge_group(self, merged, requests):
+        self.group_merges.append(len(requests))
+        value = merged.payload[0] and all(r.payload[0] for r in requests)
+        return Request(work_class=self.name, kind="echo",
+                       payload=(value, merged.payload[1]))
+
+
+def test_submit_many_matches_pairwise_results_and_counters():
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc])
+    before = REG.counter_value("sched_submitted_total", work_class="echo",
+                               kind="echo")
+    handles = sch.submit_many([_echo(v) for v in (True, False, True)])
+    sch.drain()
+    assert [h.result() for h in handles] == [True, False, True]
+    assert wc.batches == [3]
+    assert REG.counter_value("sched_submitted_total", work_class="echo",
+                             kind="echo") - before == 3
+
+
+def test_submit_many_validates_before_admitting_anything():
+    sch = Scheduler(classes=[EchoClass()])
+    with pytest.raises(ValueError, match="unknown kind"):
+        sch.submit_many([_echo(), Request(work_class="echo", kind="nope",
+                                          payload=())])
+    assert sch.queue_depth("echo") == 0  # all-or-nothing admission
+
+
+def test_submit_many_depth_trigger_fires_once_after_the_batch():
+    """Pairwise submits flush mid-batch at the depth bound; submit_many
+    admits the whole batch under one lock and triggers depth once after —
+    so the flush sees the full batch."""
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc], max_depth=4)
+    before = REG.counter_value("sched_flush_total", work_class="echo",
+                               trigger="depth")
+    handles = sch.submit_many([_echo() for _ in range(6)])
+    assert wc.batches == [6]
+    assert all(h.done() for h in handles)
+    assert REG.counter_value("sched_flush_total", work_class="echo",
+                             trigger="depth") - before == 1
+
+
+def test_submit_many_merge_group_collapses_in_one_pass():
+    wc = GroupCollapsibleEcho()
+    sch = Scheduler(classes=[wc])
+    before = REG.counter_value("sched_collapsed_total", work_class="echo")
+    hs = sch.submit_many([_keyed(True, "m1") for _ in range(4)]
+                         + [_keyed(True, "m2")])
+    assert sch.queue_load("echo") == (2, 5)
+    assert wc.group_merges == [3]  # one group call folds the 3 followers
+    sch.drain()
+    assert wc.batches == [2]
+    assert all(h.result() is True for h in hs)
+    assert REG.counter_value("sched_collapsed_total",
+                             work_class="echo") - before == 3
+
+
+def test_submit_many_merge_group_failure_isolates_members_pairwise():
+    """A raising merge_group must not fail the batch: admission falls back
+    to the pairwise path, which isolates unmergeable members individually
+    — attribution stays per-request."""
+
+    class ExplodingGroupEcho(CollapsibleEcho):
+        def merge_group(self, merged, requests):
+            raise RuntimeError("batched merge unavailable")
+
+    wc = ExplodingGroupEcho()
+    sch = Scheduler(classes=[wc])
+    hs = sch.submit_many([_keyed(True, "m"), _keyed(False, "m"),
+                          _keyed(True, "m")])
+    assert sch.queue_load("echo") == (1, 3)  # pairwise collapse still lands
+    sch.drain()
+    assert [h.result() for h in hs] == [True, False, True]
+
+
+def test_submit_many_bls_merge_group_and_malformed_isolation():
+    """Real BLS arithmetic through the batched hook: one Aggregate pass
+    collapses the clean same-message group, while a garbage signature (not
+    a decodable G2 point) is isolated into its own entry by the pairwise
+    fallback and cleanly rejects — it cannot poison the collapsed group."""
+    from consensus_specs_tpu.crypto import bls_sig
+
+    msg = b"submit-many msg"
+    sks = [71, 72, 73]
+    reqs = [Request(work_class="bls", kind="fast_aggregate",
+                    payload=([bls_sig.SkToPk(sk)], msg, bls_sig.Sign(sk, msg)))
+            for sk in sks]
+    mangled = Request(work_class="bls", kind="fast_aggregate",
+                      payload=([bls_sig.SkToPk(74)], msg, b"\xff" * 96))
+
+    wc = HostBlsClass(collapse_same_message=True)
+    sch = Scheduler(classes=[wc])
+    handles = sch.submit_many(reqs + [mangled])
+    entries, members = sch.queue_load("bls")
+    assert members == 4 and entries == 2  # clean collapse + isolated garbage
+    sch.drain()
+    assert [h.result() for h in handles] == [True, True, True, False]
+
+
+def test_queue_load_tracks_entries_vs_members():
+    wc = GroupCollapsibleEcho()
+    sch = Scheduler(classes=[wc])
+    assert sch.queue_load("echo") == (0, 0)
+    sch.submit_many([_keyed(True, "a"), _keyed(True, "a"),
+                     _keyed(True, "b")])
+    assert sch.queue_load("echo") == (2, 3)
+    assert sch.queue_depth("echo") == 2
+    sch.drain()
+    assert sch.queue_load("echo") == (0, 0)

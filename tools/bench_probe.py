@@ -90,6 +90,9 @@ def check_e2e_lane() -> int:
     rc = check_sched_lane(extra)
     if rc:
         return rc
+    rc = check_firehose_lane(extra)
+    if rc:
+        return rc
     return check_obs_snapshot()
 
 
@@ -111,6 +114,31 @@ def check_sched_lane(extra: dict) -> int:
         return 3
     print(f"# bench-probe: sched lane present "
           f"(occupancy_min={extra['sched_occupancy_min']})", file=sys.stderr)
+    return 0
+
+
+def check_firehose_lane(extra: dict) -> int:
+    """Refuse a record without the attestation-firehose soak lane: the
+    steady-state atts/s is the streaming path's headline (gossip ->
+    committee collapse -> device flush at 64 committees/slot), the
+    collapse ratio proves admission really merged same-committee
+    aggregates into one pairing check each, and the p99 comes from the
+    pipeline's own ingest->verified histogram. A bench that dropped the
+    lane would keep reporting the slot-barrier number as if the firehose
+    were still measured."""
+    missing = [k for k in ("firehose_atts_per_s_steady",
+                           "firehose_collapse_ratio",
+                           "firehose_p99_ingest_to_verified_s")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"attestation firehose soak lane (missing {missing}); fix "
+              f"benches/firehose_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: firehose lane present "
+          f"(steady={extra['firehose_atts_per_s_steady']}/s, "
+          f"collapse={extra['firehose_collapse_ratio']})", file=sys.stderr)
     return 0
 
 
