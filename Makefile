@@ -8,9 +8,9 @@ PYTHON ?= python
 TEST_VECTOR_DIR ?= ../consensus-spec-tests/tests
 GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
-             merkle random custody_sharding
+             merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched firehose lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched firehose scenarios lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -73,6 +73,20 @@ firehose:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_firehose.py tests/test_gossip_driver.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_firehose.json
+
+# Scenario-engine lane: seeded long-horizon histories (reorg storms, fork
+# ladders, equivocation waves, droughts) replayed through the oracle /
+# chaos-engine / firehose lanes with bit-identical checkpoint assertions,
+# plus the emit->replay->diff bidirectional conformance loop — see README
+# "Scenario engine". The ≥2,000-slot soak is @slow (testall/citest only);
+# this lane stays bounded for the inner loop. Obs snapshot validated like
+# the chaos/sched/firehose lanes; the scenario_* series are the artifact.
+scenarios:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_scenarios.json OBS_SNAPSHOT_LANE=scenarios \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_scenarios.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_scenarios.json
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

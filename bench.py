@@ -228,6 +228,10 @@ def run_benches() -> dict:
             import benches.firehose_bench as firehose_bench
 
             fh_r = firehose_bench.run()
+        with timed("bench_scenario"):
+            import benches.scenario_bench as scenario_bench
+
+            scen_r = scenario_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -321,6 +325,16 @@ def run_benches() -> dict:
                 fh_r["firehose_p99_ingest_to_verified_s"],
             "firehose_collapse_ratio": fh_r["firehose_collapse_ratio"],
             "firehose_queue_depth_peak": fh_r["firehose_queue_depth_peak"],
+            # scenario-engine SLO lane: chaos-enabled engine replay of a
+            # seeded long-horizon history (storms/equivocations/fork
+            # transition), plus the emit->diff double render — the
+            # bidirectional conformance loop measured end to end
+            "scenario_slots_per_s": scen_r["scenario_slots_per_s"],
+            "scenario_reorg_depth_max": scen_r["scenario_reorg_depth_max"],
+            "scenario_vectors_emitted": scen_r["scenario_vectors_emitted"],
+            "scenario_vectors_diffed": scen_r["scenario_vectors_diffed"],
+            "scenario_slots": scen_r["scenario_slots"],
+            "scenario_faults_fired": scen_r["scenario_faults_fired"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],

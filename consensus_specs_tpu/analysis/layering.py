@@ -36,11 +36,12 @@ class LayeringConfig:
     jax_free: tuple[str, ...] = (
         "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
         "crypto/das.py", "robustness/", "obs/", "sched/", "firehose/",
+        "scenarios/",
     )
     # (importer pattern, forbidden import pattern) over module paths
     forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)
     test_only: tuple[str, ...] = ("testlib/",)
-    test_consumers: tuple[str, ...] = ("testlib/", "spec_tests/")
+    test_consumers: tuple[str, ...] = ("testlib/", "spec_tests/", "scenarios/")
     # external import roots that count as "jax"
     jax_roots: tuple[str, ...] = ("jax", "jaxlib")
     # package-internal module basenames that imply jax regardless of content

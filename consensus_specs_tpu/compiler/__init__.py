@@ -1,1 +1,6 @@
-from .spec_compiler import build_spec, get_spec, parse_spec_markdown  # noqa: F401
+from .spec_compiler import (  # noqa: F401
+    build_spec,
+    get_spec,
+    get_spec_with_overrides,
+    parse_spec_markdown,
+)

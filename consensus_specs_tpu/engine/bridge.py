@@ -26,7 +26,7 @@ from ..robustness.retry import (
     call_with_retry,
     is_device_failure,
 )
-from .epoch import epoch_fn_for, historical_batch_root
+from .epoch import epoch_fn_for
 from .state import DIRTY_TRACKED, EpochConfig, EpochState
 from .sync_committee import next_sync_committee_indices
 
@@ -44,6 +44,25 @@ def _root_to_words(root: bytes) -> np.ndarray:
 
 def _words_to_root(words) -> bytes:
     return words_to_bytes(np.asarray(words, dtype=np.uint32))
+
+
+def sched_historical_batch_root(block_roots, state_roots) -> bytes:
+    """HistoricalBatch hash_tree_root through the scheduler's Merkle lane.
+
+    htr(HistoricalBatch) = hash(htr(block_roots), htr(state_roots)), and
+    with SLOTS_PER_HISTORICAL_ROOT a power of two that equals the chunk
+    tree over the two vectors' concatenated leaves — so the append
+    epilogue's root rides the same shape-bucketed `tree_root_batch`
+    program every other Merkle client compiles against instead of
+    carrying its own XLA program (`engine.epoch.historical_batch_root`
+    stays as the differential oracle)."""
+    from ..sched import Request, default_scheduler
+
+    chunks = [_words_to_root(w) for w in np.asarray(block_roots)]
+    chunks += [_words_to_root(w) for w in np.asarray(state_roots)]
+    handle = default_scheduler().submit(Request(
+        work_class="merkle", kind="tree_root", payload=(tuple(chunks),)))
+    return handle.result()
 
 
 def _validator_columns(vals) -> dict[str, np.ndarray]:
@@ -440,10 +459,8 @@ def _apply_epoch_device(spec, state, stage_timer, dirty_aware, stats,
         state.eth1_data_votes = type(state.eth1_data_votes)()
     if bool(aux.historical_append):
         state.historical_roots.append(
-            spec.Root(
-                _words_to_root(historical_batch_root(dev_out.block_roots, dev_out.state_roots))
-            )
-        )
+            spec.Root(sched_historical_batch_root(
+                dev_out.block_roots, dev_out.state_roots)))
     if bool(aux.sync_committee_update):
         _rotate_sync_committees(spec, state)
     tick("write_back")

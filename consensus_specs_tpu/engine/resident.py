@@ -36,7 +36,7 @@ from ..obs import trace as _obs_trace
 from ..robustness import faults as rfaults
 from ..robustness.retry import DEVICE_POLICY, call_with_retry, is_retryable
 from . import bridge
-from .epoch import historical_batch_root, make_epoch_fn
+from .epoch import make_epoch_fn
 from .state import DIRTY_TRACKED, EpochConfig
 
 
@@ -259,8 +259,8 @@ class ResidentEpochEngine:
         if eth1_resets.any():
             self.state.eth1_data_votes = type(self.state.eth1_data_votes)()
         if hist_appends.any():
-            root = bridge._words_to_root(np.asarray(historical_batch_root(
-                self.dev.block_roots, self.dev.state_roots)))
+            root = bridge.sched_historical_batch_root(
+                self.dev.block_roots, self.dev.state_roots)
             for _ in range(int(hist_appends.sum())):
                 self.state.historical_roots.append(self.spec.Root(root))
         if sync_updates.any():

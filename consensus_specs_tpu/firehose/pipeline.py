@@ -21,7 +21,9 @@ a continuous service:
              inside sched for sound attribution (Wonderboom fallback).
              Fault seam: `firehose.aggregate`.
 
-  flush      a dedicated worker seals batches (size or deadline) and
+  flush      a dedicated worker seals batches (size or deadline — with
+             config.adaptive_seal the size threshold tracks the observed
+             arrival rate, see _effective_seal_depth) and
              dispatches them via Scheduler.flush. While batch N holds the
              device, producers keep packing batch N+1 into the fresh
              scheduler queue — double buffering at batch granularity, the
@@ -80,12 +82,16 @@ class FirehoseConfig:
     backpressure_wait_s: float = 0.2  # one deferral wait quantum at the bound
     drop_overflow: bool = False     # True: shed at the bound instead of deferring
     dedup_capacity: int = 1 << 20   # message-id FIFO window (evictions counted)
+    adaptive_seal: bool = False     # scale the seal depth to the arrival rate
+    arrival_halflife_s: float = 1.0  # EWMA time constant for the rate estimate
 
     def __post_init__(self):
         if self.batch_attestations < 1:
             raise ValueError("batch_attestations must be >= 1")
         if self.max_pending < self.batch_attestations:
             raise ValueError("max_pending must cover at least one batch")
+        if self.arrival_halflife_s <= 0:
+            raise ValueError("arrival_halflife_s must be positive")
 
 
 class AttestationFirehose:
@@ -121,6 +127,8 @@ class AttestationFirehose:
         self._results: dict = {}    # msg_id -> bool
         self._pending = 0           # members between ingest and verified
         self._peak = 0
+        self._rate_ewma = 0.0       # admitted members/second (EWMA)
+        self._rate_t_last: float | None = None
         self._seal = False
         self._stop = False
         self._failure: BaseException | None = None
@@ -204,6 +212,46 @@ class AttestationFirehose:
             reg.counter("firehose_ingested_total").inc()
             return item
 
+    # -- arrival-rate tracking ---------------------------------------------
+
+    def _observe_arrivals(self, members: int, now: float) -> None:
+        """Fold one admitted chunk into the arrival-rate EWMA (members/s).
+        Time-aware smoothing: a long quiet gap decays the estimate toward
+        the new instantaneous rate instead of letting stale bursts linger.
+        Caller holds self._lock."""
+        import math
+
+        if self._rate_t_last is None:
+            self._rate_t_last = now
+            return
+        dt = max(now - self._rate_t_last, 1e-6)
+        self._rate_t_last = now
+        inst = members / dt
+        alpha = 1.0 - math.exp(-dt / self.config.arrival_halflife_s)
+        self._rate_ewma += alpha * (inst - self._rate_ewma)
+        self.registry.gauge("firehose_arrival_rate").set(
+            round(self._rate_ewma, 3))
+
+    def _effective_seal_depth(self) -> int:
+        """Seal depth for the CURRENT arrival regime. Fixed mode: the
+        configured batch size. Adaptive mode: about one flush-deadline
+        window of arrivals — a steady high-rate feed fills full batches
+        (device efficiency), a trickle seals shallow batches (latency) —
+        clamped to [batch/8, batch] so a mis-estimated rate can neither
+        thrash the device with single-member launches nor starve the
+        deadline path. Caller holds self._lock."""
+        cfg = self.config
+        if not cfg.adaptive_seal:
+            return cfg.batch_attestations
+        target = int(self._rate_ewma * cfg.flush_deadline_s)
+        floor = max(1, cfg.batch_attestations // 8)
+        return max(floor, min(cfg.batch_attestations, target))
+
+    def arrival_rate(self) -> float:
+        """Current EWMA estimate of admitted members/second."""
+        with self._lock:
+            return self._rate_ewma
+
     # -- stage 2: committee-keyed aggregation ------------------------------
 
     def _aggregate_many(self, items: list) -> int:
@@ -271,7 +319,8 @@ class AttestationFirehose:
                 with self._lock:
                     for it, h in zip(chunk, handles):
                         self._awaiting.append((it.msg_id, it.key, h, now))
-                    if self._pending >= cfg.batch_attestations:
+                    self._observe_arrivals(len(chunk), now)
+                    if self._pending >= self._effective_seal_depth():
                         self._seal = True
                         self._sealed.notify_all()
                     run_inline = self._seal and not self.threaded

@@ -1,0 +1,60 @@
+"""Scenario-level fault schedules: long-horizon chaos profiles.
+
+The PR-5 chaos lane (tests/test_chaos_epoch.py) drives exact per-call
+schedules — "fire on the 3rd staged column". Scenario runs are thousands
+of device calls long, so here the schedules are RATE-based with per-site
+fire caps: a sustained drizzle of transient failures over the whole
+horizon, every fault still inside the retry/breaker/degrade envelope so
+the run must stay bit-identical to the fault-free oracle.
+
+Profiles name the seams one lane actually crosses:
+  * "engine"   — the resident-epoch bridge (dispatch raise + torn aux
+                 readout); the scenario engine lane installs this.
+  * "firehose" — the streaming attestation path (ingest/flush raises).
+  * "full"     — both, for soak runs that exercise every lane at once.
+
+Seeds follow the faults.py contract: every site draws from its own
+`Random(f"{seed}:{site}")` stream, so one lane's fire pattern never
+shifts another's (deterministic replay per seed).
+"""
+from __future__ import annotations
+
+from .faults import FaultPlan, FaultSpec
+
+# "truncate" (not "nan"): the aux-readout flag vector is boolean — a NaN
+# write can't represent there, while a truncated copy trips the structural
+# shape check in bridge._read_aux_flags exactly like a torn D2H transfer.
+ENGINE_PROFILE = {
+    "bridge.dispatch": dict(kind="raise", exc="transient"),
+    "bridge.aux_readout": dict(kind="corrupt", corruption="truncate"),
+}
+FIREHOSE_PROFILE = {
+    "firehose.ingest": dict(kind="raise", exc="transient"),
+    "firehose.flush": dict(kind="raise", exc="transient"),
+}
+PROFILES = {
+    "engine": ENGINE_PROFILE,
+    "firehose": FIREHOSE_PROFILE,
+    "full": {**ENGINE_PROFILE, **FIREHOSE_PROFILE},
+}
+
+
+def long_horizon_plan(seed: int, *, profile: str = "engine",
+                      rate: float = 0.05,
+                      max_fires_per_site: int = 8) -> FaultPlan:
+    """A seeded drizzle-of-faults plan for a multi-thousand-slot run.
+
+    `rate` is per-crossing: with the default retry budget (4 attempts) a
+    5% transient rate keeps the chance of even one exhausted budget over
+    hundreds of epochs negligible, so convergence failures point at real
+    divergence, not at fault-schedule bad luck. `max_fires_per_site`
+    bounds total injected damage so soak wall-clock stays predictable.
+    """
+    if profile not in PROFILES:
+        raise ValueError(f"unknown profile {profile!r} "
+                         f"(have: {sorted(PROFILES)})")
+    sites = {
+        site: FaultSpec(rate=rate, max_fires=max_fires_per_site, **kw)
+        for site, kw in PROFILES[profile].items()
+    }
+    return FaultPlan(seed=seed, sites=sites)

@@ -1,0 +1,34 @@
+"""Long-horizon scenario engine (ROADMAP item 4 — the L6/L7 closure).
+
+A scenario is a seeded, randomized multi-epoch adversarial history —
+reorg storms, fork ladders (proposer equivocation), slashing waves,
+empty-slot droughts, sync-committee rotation across a fork boundary —
+materialized ONCE (`history.build_history`) as spec-valid SSZ objects plus
+a replayable step script, then replayed through three lanes
+(`lanes.oracle_lane` / `engine_lane` / `firehose_lane`) that must agree
+bit-identically on every checkpoint (fork-choice head + head state root +
+justified/finalized checkpoints).
+
+The L7 loop closes in `emit`/`diff`: scenario segments are written from
+the TPU lane into the reference `<preset>/<fork>/<runner>/<handler>`
+vector tree via gen/, replayed back through conformance.runner, and
+diffed field-by-field against reference-shaped (oracle-emitted) vectors —
+conformance in BOTH directions.
+
+jax-free at module level (analysis/layering.py pins this): every device
+dependency (engine bridge, sched dispatch) is a deferred import inside
+the lane that needs it, so scripting/diffing scenarios never drags in a
+TPU runtime.
+"""
+from .script import EpochPlan, ScenarioScript, build_script  # noqa: F401
+from .history import ScenarioHistory, Segment, build_history  # noqa: F401
+from .lanes import (  # noqa: F401
+    LaneResult,
+    assert_converged,
+    engine_lane,
+    firehose_lane,
+    oracle_lane,
+    replay_history,
+)
+from .emit import emit_history, scenario_test_cases  # noqa: F401
+from .diff import diff_vector_trees  # noqa: F401

@@ -1,0 +1,151 @@
+"""L7 closure, inbound: load reference-shaped vector trees back and diff
+them field-by-field.
+
+`diff_vector_trees(a, b)` walks two `<preset>/<fork>/<runner>/<handler>/
+<suite>/<case>` trees (either a repo root containing `tests/` or the tests
+dir itself) and returns a list of human-readable difference strings —
+empty means byte-identical trees. Byte equality is the primary check (the
+emission contract is deterministic down to the snappy framing); when a
+file's bytes DO differ, the payload is decoded — ssz_snappy through the
+spec types, yaml through safe_load — and the first divergent fields are
+named (`state.balances[3]: 100 != 101`), because "vector differs" without
+a field path is undebuggable at scenario scale.
+
+This is the inbound half of bidirectional conformance: vectors emitted
+from the TPU lane are diffed against reference-shaped (oracle-emitted)
+vectors, while conformance.runner.replay_case independently replays both.
+
+jax-free by charter: spec modules load through the compiler's host path.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import yaml
+
+from ..native import snappy
+
+MAX_DIFFS_PER_FILE = 12
+
+
+def _tests_root(tree) -> Path:
+    root = Path(tree)
+    return root / "tests" if (root / "tests").is_dir() else root
+
+
+def _files(root: Path) -> dict:
+    return {str(p.relative_to(root)): p
+            for p in sorted(root.rglob("*"))
+            if p.is_file() and p.name != "testgen_error_log.txt"}
+
+
+def _spec_for(rel: str, case_dir: Path):
+    """Resolve the case's spec module from its tree position (+ config.yaml
+    overrides, mirroring conformance.runner.replay_case)."""
+    from ..compiler import get_spec, get_spec_with_overrides
+
+    parts = Path(rel).parts
+    preset, fork = parts[0], parts[1]
+    cfg_path = case_dir / "config.yaml"
+    if cfg_path.exists():
+        with open(cfg_path) as f:
+            overrides = yaml.safe_load(f) or {}
+        converted = {
+            k: bytes.fromhex(v[2:])
+            if isinstance(v, str) and v.startswith("0x") else v
+            for k, v in overrides.items()
+        }
+        return get_spec_with_overrides(fork, preset, converted)
+    return get_spec(fork, preset)
+
+
+def _ssz_type(spec, stem: str):
+    if stem in ("anchor_state", "pre", "post", "state", "genesis"):
+        return spec.BeaconState
+    if stem == "anchor_block":
+        return spec.BeaconBlock
+    if stem.startswith(("block_", "blocks_")):
+        return spec.SignedBeaconBlock
+    if stem.startswith("attestation"):
+        return spec.Attestation
+    if stem.startswith("pow_block") and hasattr(spec, "PowBlock"):
+        return spec.PowBlock
+    return None
+
+
+def _deep_diff(a, b, path: str, out: list) -> None:
+    if len(out) >= MAX_DIFFS_PER_FILE:
+        return
+    if isinstance(a, dict) and isinstance(b, dict):
+        for key in sorted(set(a) | set(b)):
+            if key not in a:
+                out.append(f"{path}.{key}: missing on left")
+            elif key not in b:
+                out.append(f"{path}.{key}: missing on right")
+            else:
+                _deep_diff(a[key], b[key], f"{path}.{key}", out)
+            if len(out) >= MAX_DIFFS_PER_FILE:
+                return
+    elif isinstance(a, list) and isinstance(b, list):
+        if len(a) != len(b):
+            out.append(f"{path}: length {len(a)} != {len(b)}")
+        for i, (x, y) in enumerate(zip(a, b)):
+            _deep_diff(x, y, f"{path}[{i}]", out)
+            if len(out) >= MAX_DIFFS_PER_FILE:
+                return
+    elif a != b:
+        out.append(f"{path}: {a!r} != {b!r}")
+
+
+def _field_diff(rel: str, path_a: Path, path_b: Path,
+                raw_a: bytes, raw_b: bytes) -> list:
+    name = Path(rel).name
+    out: list = []
+    if name.endswith(".yaml"):
+        _deep_diff(yaml.safe_load(raw_a.decode()),
+                   yaml.safe_load(raw_b.decode()),
+                   Path(name).stem, out)
+    elif name.endswith(".ssz_snappy"):
+        from ..debug.encode import encode
+
+        stem = name.removesuffix(".ssz_snappy")
+        spec = _spec_for(rel, path_a.parent)
+        typ = _ssz_type(spec, stem)
+        if typ is None:
+            return [f"binary mismatch ({len(raw_a)} vs {len(raw_b)} bytes, "
+                    f"no decoder for {stem!r})"]
+        try:
+            val_a = encode(typ.decode_bytes(snappy.decompress(raw_a)))
+            val_b = encode(typ.decode_bytes(snappy.decompress(raw_b)))
+        except Exception as exc:
+            return [f"binary mismatch (decode failed: "
+                    f"{type(exc).__name__}: {exc})"]
+        _deep_diff(val_a, val_b, stem, out)
+        if not out:
+            out.append("ssz bodies decode equal but serialized bytes "
+                       "differ (framing/compression drift)")
+    else:
+        out.append(f"binary mismatch ({len(raw_a)} vs {len(raw_b)} bytes)")
+    return out
+
+
+def diff_vector_trees(tree_a, tree_b) -> list:
+    """Field-by-field diff of two vector trees; [] means identical."""
+    root_a, root_b = _tests_root(tree_a), _tests_root(tree_b)
+    files_a, files_b = _files(root_a), _files(root_b)
+    diffs: list = []
+    for rel in sorted(set(files_a) | set(files_b)):
+        if rel not in files_a:
+            diffs.append(f"{rel}: only in {root_b}")
+            continue
+        if rel not in files_b:
+            diffs.append(f"{rel}: only in {root_a}")
+            continue
+        raw_a = files_a[rel].read_bytes()
+        raw_b = files_b[rel].read_bytes()
+        if raw_a == raw_b:
+            continue
+        for detail in _field_diff(rel, files_a[rel], files_b[rel],
+                                  raw_a, raw_b):
+            diffs.append(f"{rel}: {detail}")
+    return diffs

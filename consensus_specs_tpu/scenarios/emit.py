@@ -1,0 +1,157 @@
+"""L7 closure, outbound: scenario segments → reference-shaped vector trees.
+
+`scenario_test_cases` turns one materialized history into gen/ TestCases
+for two runner/handler pairs, and `emit_history` writes them through the
+standard `gen_runner.run_generator` machinery (same snappy/yaml dumpers,
+same `<preset>/<fork>/<runner>/<handler>/<suite>/<case>` layout, same
+INCOMPLETE sentinel), so scenario vectors are indistinguishable from any
+other generator's output and replay through conformance.runner unchanged:
+
+  fork_choice/scenario   anchor_state + anchor_block + every block/
+                         attestation object + steps.yaml whose `checks`
+                         payloads come from the SUPPLIED lane's replay
+                         (pass the engine lane's LaneResult and the
+                         vectors assert what the TPU implementation
+                         computed — the outbound half of bidirectional
+                         conformance).
+  sanity/blocks          pre / blocks_i (the canonical chain) / post per
+                         segment — the same history cross-checked through
+                         the state-transition runner instead of the store.
+
+Determinism contract (satellite: double-render test): emitting the same
+history twice yields byte-identical trees — no wall clock, no unseeded
+iteration order anywhere in the part lists.
+"""
+from __future__ import annotations
+
+from ..gen import TestCase, TestProvider, run_generator
+from .history import ScenarioHistory
+from .lanes import LaneResult
+
+SUITE = "pyspec_tests"
+
+
+def _segment_checks(history: ScenarioHistory, lane_result: LaneResult) -> list:
+    """Per-segment slices of the lane's checkpoint `checks` payloads, in
+    step order (each segment consumes as many as it has checkpoint steps)."""
+    per_segment = []
+    cursor = 0
+    for seg in history.segments:
+        n = sum(1 for step in seg.steps if "checkpoint" in step)
+        chunk = lane_result.checkpoints[cursor:cursor + n]
+        assert len(chunk) == n, (
+            f"lane '{lane_result.name}' recorded {len(lane_result.checkpoints)} "
+            f"checkpoints; segment needs {n} more at offset {cursor}")
+        per_segment.append([cp["checks"] for cp in chunk])
+        cursor += n
+    return per_segment
+
+
+def _fork_choice_case_fn(history, seg, checks):
+    def case_fn():
+        steps = []
+        it = iter(checks)
+        for step in seg.steps:
+            if "tick" in step or "block" in step or "attestation" in step:
+                steps.append(dict(step))
+            elif "checkpoint" in step:
+                steps.append({"checks": next(it)})
+            # probe steps are a lane-internal sampling aid, not part of the
+            # reference step vocabulary — dropped on emission
+        parts = [
+            ("anchor_state", "ssz", seg.anchor_state),
+            ("anchor_block", "ssz", seg.anchor_block),
+        ]
+        for name, obj in seg.objects.items():
+            parts.append((name, "ssz", obj))
+        parts.append(("config", "data", dict(seg.config_overrides)))
+        parts.append(("steps", "data", steps))
+        parts.append(("meta", "meta", {
+            "bls_setting": 2,  # stub-signed traffic: must replay unverified
+            "scenario_seed": history.script.seed,
+        }))
+        return parts
+
+    return case_fn
+
+
+def _sanity_blocks_case_fn(history, seg):
+    def case_fn():
+        from ..compiler import get_spec_with_overrides
+        from ..crypto import bls
+
+        spec = get_spec_with_overrides(
+            seg.fork, history.script.preset, seg.config_overrides)
+        anchor_slot = int(seg.anchor_state.slot)
+        blocks = [seg.objects[name] for name in seg.canonical
+                  if int(seg.objects[name].message.slot) > anchor_slot]
+        post = seg.anchor_state.copy()
+        prev = bls.bls_active
+        bls.bls_active = False
+        try:
+            for signed in blocks:
+                spec.state_transition(post, signed, validate_result=True)
+        finally:
+            bls.bls_active = prev
+        parts = [("pre", "ssz", seg.anchor_state)]
+        for i, signed in enumerate(blocks):
+            parts.append((f"blocks_{i}", "ssz", signed))
+        parts.append(("post", "ssz", post))
+        parts.append(("config", "data", dict(seg.config_overrides)))
+        parts.append(("meta", "meta", {
+            "bls_setting": 2,
+            "blocks_count": len(blocks),
+            "scenario_seed": history.script.seed,
+        }))
+        return parts
+
+    return case_fn
+
+
+def scenario_test_cases(history: ScenarioHistory,
+                        lane_result: LaneResult | None = None) -> list:
+    """gen/ TestCases for one history: fork_choice/scenario + sanity/blocks
+    per segment. `lane_result` supplies the checks payloads (default: a
+    fresh oracle replay; pass the engine lane's result to emit what the
+    TPU implementation computed)."""
+    if lane_result is None:
+        from .lanes import oracle_lane
+
+        lane_result = oracle_lane(history)
+    checks = _segment_checks(history, lane_result)
+    script = history.script
+    cases = []
+    for i, seg in enumerate(history.segments):
+        case_name = f"{script.name}_seg{i}"
+        cases.append(TestCase(
+            fork_name=seg.fork, preset_name=script.preset,
+            runner_name="fork_choice", handler_name="scenario",
+            suite_name=SUITE, case_name=case_name,
+            case_fn=_fork_choice_case_fn(history, seg, checks[i])))
+        cases.append(TestCase(
+            fork_name=seg.fork, preset_name=script.preset,
+            runner_name="sanity", handler_name="blocks",
+            suite_name=SUITE, case_name=case_name,
+            case_fn=_sanity_blocks_case_fn(history, seg)))
+    return cases
+
+
+def emit_history(history: ScenarioHistory, output_dir, *,
+                 lane_result: LaneResult | None = None,
+                 force: bool = True, smoke: int | None = None) -> list:
+    """Write the history's vector cases under `<output_dir>/tests/...` via
+    the standard generator runtime. Returns the emitted case paths.
+    `smoke=N` stops the run after N cases (the generator health probe)."""
+    cases = scenario_test_cases(history, lane_result=lane_result)
+    if smoke is not None:
+        cases = cases[:smoke]
+    providers = [TestProvider(make_cases=lambda: list(cases))]
+    argv = ["-o", str(output_dir)] + (["-f"] if force else [])
+    if smoke is not None:
+        argv += ["--smoke", str(smoke)]
+    rc = run_generator("scenarios", providers, argv)
+    if rc != 0:
+        raise RuntimeError(
+            f"scenario vector emission failed (rc {rc}); see "
+            f"{output_dir}/testgen_error_log.txt")
+    return [case.path for case in cases]

@@ -1,0 +1,538 @@
+"""Materialize a ScenarioScript into spec-valid SSZ objects + a step script.
+
+The history is built ONCE per (seed, shape) and replayed by every lane
+(lanes.py) and by the vector emitter (emit.py), so bit-identity questions
+reduce to "did the lanes process the same steps the same way" — never
+"did two builders roll the same dice".
+
+Mechanics (all under LMD-GHOST's one-sticky-vote-per-validator-per-epoch
+rule — on_attestation only supersedes an earlier vote from a PRIOR epoch):
+
+* calm epochs: one block per slot carrying full-committee attestations for
+  the previous slot (justification/finality advances), plus the same votes
+  gossiped as standalone attestation steps (fork-choice weight).
+* droughts: every `skip_every`-th slot is tick-only; gossip votes continue,
+  re-attesting the stale head across the gap.
+* reorg storms: the public branch runs `public` blocks and collects that
+  many slots of sticky votes; a private branch of `private > 2*public`
+  blocks (equivocating with the public proposers on the shared slots) is
+  released late together with the still-unspent committee votes of the
+  silent slots — the private branch strictly outweighs the public one and
+  the head flips. `probe` steps bracket the release so lanes measure the
+  reorg depth identically.
+* equivocation ladders: a proposer signs two sibling blocks in one slot
+  (both enter the store); the pair's headers become a proposer slashing
+  included two slots later.
+* slashing waves: an attester double-vote slashes a whole committee via a
+  block-included attester slashing.
+* fork boundary: the canonical chain upgrades (upgrade_to_<post>) at the
+  scripted epoch; the first post-fork block anchors a FRESH fork-choice
+  store (its state_root seals the anchor contract get_forkchoice_store
+  asserts), matching the reference's per-fork store scoping.
+
+Deferred spec imports only — this module stays importable from the
+jax-free layer (analysis/layering.py pins `scenarios/`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from random import Random
+
+from ..obs import metrics as _obs_metrics
+from .script import (
+    CALM,
+    DROUGHT,
+    EQUIVOCATION,
+    REORG_STORM,
+    SLASHING_WAVE,
+    ScenarioScript,
+    build_script,
+)
+
+
+@dataclass
+class Segment:
+    """One fork's worth of scenario: a store anchor plus replayable steps.
+
+    steps entries (replayed in order by every lane):
+      {"tick": <time>}            — spec.on_tick
+      {"block": <name>}           — spec.on_block + in-block attestation routing
+      {"attestation": <name>}     — spec.on_attestation (gossip path)
+      {"checkpoint": <epoch>}     — lanes snapshot checks + head state root
+      {"probe": <label>}          — lanes sample get_head (reorg detection)
+    """
+
+    fork: str
+    config_overrides: dict
+    anchor_state: object
+    anchor_block: object
+    steps: list = field(default_factory=list)
+    objects: dict = field(default_factory=dict)
+    # name -> {"pubkeys": [bytes], "message": bytes, "signature": bytes}
+    # (the firehose lane's classification table — scenario gossip carries
+    # stub signatures, so classification is a pure lookup, not re-derivation)
+    att_keys: dict = field(default_factory=dict)
+    canonical: list = field(default_factory=list)  # block names, chain order
+    start_slot: int = 0
+    end_slot: int = 0
+
+
+@dataclass
+class ScenarioHistory:
+    script: ScenarioScript
+    segments: list
+    stats: dict
+
+
+def build_history(script_or_seed, **script_kwargs) -> ScenarioHistory:
+    """Materialize a script (or build one from a seed) into a history."""
+    from ..compiler import get_spec_with_overrides
+    from ..crypto import bls
+    from ..testlib.context import _cached_genesis, default_balances
+
+    script = (script_or_seed if isinstance(script_or_seed, ScenarioScript)
+              else build_script(script_or_seed, **script_kwargs))
+    pre_fork, post_fork = script.forks
+    overrides = {f"{post_fork.upper()}_FORK_EPOCH": script.fork_epoch}
+    # memoized spec modules: the lanes replay with the SAME module objects
+    # the builder used, so SSZ class identity and per-module caches line up
+    pre_spec = get_spec_with_overrides(pre_fork, script.preset, overrides)
+    post_spec = get_spec_with_overrides(post_fork, script.preset, overrides)
+
+    prev_bls = bls.bls_active
+    bls.bls_active = False  # stub signatures: the scenario contract (README)
+    try:
+        genesis = _cached_genesis(
+            pre_spec, default_balances, lambda s: s.MAX_EFFECTIVE_BALANCE)
+        builder = _HistoryBuilder(script)
+        fork_slot = script.fork_epoch * int(pre_spec.SLOTS_PER_EPOCH)
+
+        # --- pre-fork segment: genesis-anchored store -------------------
+        anchor_block = pre_spec.BeaconBlock(
+            state_root=pre_spec.hash_tree_root(genesis))
+        builder.open_segment(
+            pre_spec, pre_fork, dict(overrides), genesis.copy(), anchor_block,
+            start_slot=0)
+        for epoch in range(script.fork_epoch):
+            builder.run_epoch(epoch)
+        builder.close_segment(fork_slot, checkpoint_epoch=script.fork_epoch)
+
+        # --- fork transition: the epoch transition INTO the fork epoch runs
+        # under the pre spec (reference transition-test semantics), then the
+        # state upgrades and the first post-fork block anchors a fresh store.
+        # That block sits at the NEXT epoch start (the fork epoch stays
+        # blockless): get_forkchoice_store pins finalized = (anchor_epoch,
+        # anchor_root), and on_block's finalized-ancestor walk targets the
+        # anchor epoch's start slot — an off-boundary anchor would make the
+        # walk recurse past the anchor into pre-fork roots the store lacks.
+        state = builder.state
+        pre_spec.process_slots(state, fork_slot)
+        upgraded = getattr(post_spec, f"upgrade_to_{post_fork}")(state)
+        anchor_slot = fork_slot + int(post_spec.SLOTS_PER_EPOCH)
+        first_block = _build_signed_block(post_spec, upgraded, anchor_slot)
+        builder.open_segment(
+            post_spec, post_fork, dict(overrides), upgraded.copy(),
+            first_block.message, start_slot=anchor_slot, state=upgraded,
+            canonical_head=first_block)
+        builder.queue_votes(anchor_slot)
+        for epoch in range(script.fork_epoch + 1, script.epochs):
+            builder.run_epoch(epoch)
+        builder.close_segment(
+            script.epochs * int(post_spec.SLOTS_PER_EPOCH),
+            checkpoint_epoch=script.epochs)
+        return ScenarioHistory(
+            script=script, segments=builder.segments, stats=builder.stats)
+    finally:
+        bls.bls_active = prev_bls
+
+
+def _build_signed_block(spec, state, slot, *, graffiti=None, atts=(),
+                        proposer_slashings=(), attester_slashings=()):
+    """Build + apply one block AT `slot`, mutating `state` to its post-state."""
+    from ..testlib.block import build_empty_block, state_transition_and_sign_block
+
+    assert state.slot < slot, (int(state.slot), int(slot))
+    block = build_empty_block(spec, state, slot=slot)
+    if graffiti is not None:
+        block.body.graffiti = spec.Bytes32(graffiti.ljust(32, b"\x00"))
+    for slashing in proposer_slashings:
+        block.body.proposer_slashings.append(slashing)
+    for slashing in attester_slashings:
+        block.body.attester_slashings.append(slashing)
+    for att in atts:
+        block.body.attestations.append(att)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def _header_of(spec, signed_block):
+    """SignedBeaconBlockHeader equivalent of a signed block: the header's
+    hash_tree_root equals the block's (body_root substitution), so the block
+    signature verifies over the header too — equivocating blocks ARE
+    proposer-slashing evidence without re-signing."""
+    b = signed_block.message
+    return spec.SignedBeaconBlockHeader(
+        message=spec.BeaconBlockHeader(
+            slot=b.slot, proposer_index=b.proposer_index,
+            parent_root=b.parent_root, state_root=b.state_root,
+            body_root=spec.hash_tree_root(b.body)),
+        signature=signed_block.signature)
+
+
+class _HistoryBuilder:
+    """Stateful walk over the script, one epoch routine per event kind."""
+
+    def __init__(self, script: ScenarioScript):
+        self.script = script
+        self.rng = Random(f"scenario:{script.seed}:materialize")
+        self.segments: list = []
+        self.stats = {
+            "blocks": 0, "attestations": 0, "equivocations": 0,
+            "proposer_slashings": 0, "attester_slashings": 0,
+            "storms": 0, "droughts": 0, "skipped_proposals": 0,
+            "suppressed_votes": 0, "planned_reorg_depth_max": 0,
+        }
+        self.slashed: set = set()
+        self.known_roots: set = set()  # block roots the segment's store holds
+        self.spec = None
+        self.seg: Segment | None = None
+        self.state = None           # canonical post-state at the built head
+        self.chain: list = []       # canonical block names, genesis->head
+        self.pending_atts: list = []   # gossip votes awaiting the next tick
+        self.pending_proposer_slashings: list = []
+        self.pending_attester_slashings: list = []
+        self._registry = _obs_metrics.REGISTRY
+
+    # -- segment plumbing ---------------------------------------------------
+
+    def open_segment(self, spec, fork, overrides, anchor_state, anchor_block,
+                     *, start_slot, state=None, canonical_head=None) -> Segment:
+        self.spec = spec
+        self.seg = Segment(
+            fork=fork, config_overrides=overrides, anchor_state=anchor_state,
+            anchor_block=anchor_block, start_slot=start_slot,
+            end_slot=start_slot)
+        if state is not None:
+            self.state = state
+        elif self.state is None:
+            self.state = anchor_state.copy()
+        self.chain = []
+        self.pending_atts = []
+        self.known_roots = {bytes(spec.hash_tree_root(anchor_block))}
+        if canonical_head is not None:
+            # the anchor block doubles as the first canonical chain entry
+            name = self._register_block(canonical_head)
+            self.chain.append(name)
+        self.segments.append(self.seg)
+        return self.seg
+
+    def close_segment(self, final_slot, *, checkpoint_epoch):
+        self.tick(final_slot)
+        self.flush_votes()
+        self.seg.steps.append({"checkpoint": int(checkpoint_epoch)})
+        self.seg.end_slot = final_slot
+        self.seg.canonical = list(self.chain)
+
+    def tick(self, slot):
+        spec, seg = self.spec, self.seg
+        time = (int(seg.anchor_state.genesis_time)
+                + int(slot) * int(spec.config.SECONDS_PER_SLOT))
+        seg.steps.append({"tick": time})
+        self._registry.counter("scenario_build_slots_total").inc()
+
+    def flush_votes(self):
+        for name in self.pending_atts:
+            self.seg.steps.append({"attestation": name})
+        self.pending_atts = []
+
+    def start_slot_steps(self, slot, epoch):
+        """tick → flush queued gossip votes → epoch-boundary checkpoint."""
+        self.tick(slot)
+        self.flush_votes()
+        if slot % int(self.spec.SLOTS_PER_EPOCH) == 0:
+            self.seg.steps.append({"checkpoint": int(epoch)})
+
+    # -- object registration ------------------------------------------------
+
+    def _register_block(self, signed_block) -> str:
+        spec, seg = self.spec, self.seg
+        root = spec.hash_tree_root(signed_block.message)
+        name = f"block_{bytes(root).hex()[:16]}"
+        seg.objects[name] = signed_block
+        self.known_roots.add(bytes(root))
+        return name
+
+    def _vote_admissible(self, att) -> bool:
+        """A gossip vote is only scripted when the segment's store can
+        accept it: validate_on_attestation requires both the voted head and
+        the target root to be in store.blocks, and a fresh post-fork store
+        does not hold pre-anchor blocks — first-epoch-after-fork votes
+        (target = the boundary root) are suppressed, not emitted-and-
+        expected-to-fail, so emitted vectors replay clean."""
+        if (bytes(att.data.beacon_block_root) in self.known_roots
+                and bytes(att.data.target.root) in self.known_roots):
+            return True
+        self.stats["suppressed_votes"] += 1
+        return False
+
+    def _register_att(self, att, state) -> str:
+        spec, seg = self.spec, self.seg
+        root = spec.hash_tree_root(att)
+        name = f"attestation_{bytes(root).hex()[:16]}"
+        if name not in seg.objects:
+            seg.objects[name] = att
+            participants = sorted(spec.get_attesting_indices(
+                state, att.data, att.aggregation_bits))
+            domain = spec.get_domain(
+                state, spec.DOMAIN_BEACON_ATTESTER, att.data.target.epoch)
+            message = spec.compute_signing_root(att.data, domain)
+            seg.att_keys[name] = {
+                "pubkeys": [bytes(state.validators[i].pubkey)
+                            for i in participants],
+                "message": bytes(message),
+                "signature": bytes(att.signature),
+            }
+        return name
+
+    # -- building blocks ----------------------------------------------------
+
+    def _slot_proposer_slashed(self, state, slot) -> bool:
+        """Probe whether `slot`'s proposer (from `state`'s fork of history)
+        is already slashed — such a slot must go blockless on that branch,
+        since process_block_header rejects slashed proposers."""
+        if not self.slashed:
+            return False
+        spec = self.spec
+        probe = state.copy()
+        if probe.slot < slot:
+            spec.process_slots(probe, slot)
+        proposer = spec.get_beacon_proposer_index(probe)
+        return bool(probe.validators[proposer].slashed)
+
+    def _proposer_blocked(self, slot) -> bool:
+        if self._slot_proposer_slashed(self.state, slot):
+            self.stats["skipped_proposals"] += 1
+            return True
+        return False
+
+    def _take_pending_ops(self):
+        spec = self.spec
+        pro = self.pending_proposer_slashings[
+            :int(spec.MAX_PROPOSER_SLASHINGS)]
+        att = self.pending_attester_slashings[
+            :int(spec.MAX_ATTESTER_SLASHINGS)]
+        self.pending_proposer_slashings = self.pending_proposer_slashings[len(pro):]
+        self.pending_attester_slashings = self.pending_attester_slashings[len(att):]
+        return pro, att
+
+    def canonical_block(self, slot, *, atts=(), graffiti=None) -> str | None:
+        """Build + emit one canonical block step; None if the proposer is
+        slashed (tick-only slot)."""
+        if self._proposer_blocked(slot):
+            if self.state.slot < slot:
+                self.spec.process_slots(self.state, slot)
+            return None
+        pro, att_sl = self._take_pending_ops()
+        signed = _build_signed_block(
+            self.spec, self.state, slot, graffiti=graffiti, atts=atts,
+            proposer_slashings=pro, attester_slashings=att_sl)
+        name = self._register_block(signed)
+        self.seg.steps.append({"block": name})
+        self.chain.append(name)
+        self.stats["blocks"] += 1
+        self.stats["proposer_slashings"] += len(pro)
+        self.stats["attester_slashings"] += len(att_sl)
+        self._registry.counter("scenario_build_blocks_total").inc()
+        return name
+
+    def queue_votes(self, slot, *, state=None):
+        """Full-committee gossip votes for `slot`, emitted at the next tick
+        (on_attestation requires attestation.data.slot + 1 <= wall slot)."""
+        from ..testlib.attestations import get_valid_attestations_at_slot
+
+        spec = self.spec
+        state = state if state is not None else self.state
+        assert state.slot == slot, (state.slot, slot)
+        for att in get_valid_attestations_at_slot(spec, state, slot):
+            if not self._vote_admissible(att):
+                continue
+            self.pending_atts.append(self._register_att(att, state))
+            self.stats["attestations"] += 1
+            self._registry.counter("scenario_build_attestations_total").inc()
+
+    def prev_slot_block_atts(self, slot):
+        """Attestations for slot-1 to include IN the block at `slot` (the
+        justification driver: in-state participation only advances through
+        block-included attestations)."""
+        from ..testlib.attestations import get_valid_attestations_at_slot
+
+        return get_valid_attestations_at_slot(self.spec, self.state, slot - 1)
+
+    # -- epoch routines -----------------------------------------------------
+
+    def run_epoch(self, epoch: int):
+        plan = self.script.plan_for(epoch)
+        spec = self.spec
+        per_epoch = int(spec.SLOTS_PER_EPOCH)
+        first = epoch * per_epoch
+        # the genesis slot carries no block, and a segment-opening slot is
+        # already consumed by the anchor block
+        slots = [s for s in range(first, first + per_epoch)
+                 if s > self.seg.start_slot]
+        if not slots:
+            return
+        routine = {
+            CALM: self._calm_epoch,
+            DROUGHT: self._drought_epoch,
+            REORG_STORM: self._storm_epoch,
+            EQUIVOCATION: self._equivocation_epoch,
+            SLASHING_WAVE: self._slashing_wave_epoch,
+        }[plan.kind]
+        routine(epoch, slots, plan.params)
+        self.seg.end_slot = slots[-1]
+        self.seg.canonical = list(self.chain)
+
+    def _calm_epoch(self, epoch, slots, params, *, graffiti=None):
+        for slot in slots:
+            self.start_slot_steps(slot, epoch)
+            atts = self.prev_slot_block_atts(slot)
+            self.canonical_block(slot, atts=atts, graffiti=graffiti)
+            self.queue_votes(slot)
+
+    def _drought_epoch(self, epoch, slots, params):
+        self.stats["droughts"] += 1
+        skip_every = int(params.get("skip_every", 2))
+        for i, slot in enumerate(slots):
+            self.start_slot_steps(slot, epoch)
+            if i % skip_every == 0:
+                # tick-only slot: advance the canonical state so gossip
+                # votes for the empty slot still resolve their committee
+                if self.state.slot < slot:
+                    self.spec.process_slots(self.state, slot)
+            else:
+                self.canonical_block(slot)
+            self.queue_votes(slot)
+
+    def _equivocation_epoch(self, epoch, slots, params):
+        spec = self.spec
+        rung_offsets = (1, 4)[:int(params.get("rungs", 1))]
+        rung_slots = {slots[0] + off for off in rung_offsets
+                      if slots[0] + off <= slots[-1]}
+        for slot in slots:
+            self.start_slot_steps(slot, epoch)
+            if slot in rung_slots and not self._proposer_blocked(slot):
+                pre = self.state.copy()
+                name = self.canonical_block(slot, graffiti=b"rung-a")
+                if name is not None:
+                    rival_state = pre
+                    rival = _build_signed_block(
+                        spec, rival_state, slot, graffiti=b"rung-b")
+                    rival_name = self._register_block(rival)
+                    # canonical sibling first: it takes the proposer boost
+                    self.seg.steps.append({"block": rival_name})
+                    self.stats["equivocations"] += 1
+                    self._registry.counter(
+                        "scenario_build_equivocations_total").inc()
+                    proposer = int(rival.message.proposer_index)
+                    if proposer not in self.slashed:
+                        canonical = self.seg.objects[name]
+                        self.pending_proposer_slashings.append(
+                            spec.ProposerSlashing(
+                                signed_header_1=_header_of(spec, canonical),
+                                signed_header_2=_header_of(spec, rival)))
+                        self.slashed.add(proposer)
+            else:
+                self.canonical_block(slot)
+            self.queue_votes(slot)
+
+    def _slashing_wave_epoch(self, epoch, slots, params):
+        from ..testlib.slashings import build_attester_slashing
+
+        spec = self.spec
+        armed = bool(params.get("attester", True))
+        for i, slot in enumerate(slots):
+            self.start_slot_steps(slot, epoch)
+            if i == 1 and armed:
+                slashing = build_attester_slashing(spec, self.state)
+                self.pending_attester_slashings.append(slashing)
+                self.slashed |= set(
+                    map(int, slashing.attestation_1.attesting_indices))
+                self._registry.counter(
+                    "scenario_build_slashing_waves_total").inc()
+            self.canonical_block(slot)
+            self.queue_votes(slot)
+
+    def _storm_epoch(self, epoch, slots, params):
+        spec, seg = self.spec, self.seg
+        self.stats["storms"] += 1
+        public = min(int(params.get("public", 1)), max(1, len(slots) - 3))
+        private = min(int(params.get("private", public * 2 + 1)), len(slots) - 1)
+        if private <= 2 * public:  # weight-flip invariant (script guards too)
+            private = min(2 * public + 1, len(slots) - 1)
+        fork_state = self.state.copy()
+        fork_chain_len = len(self.chain)
+        public_head_slot = None
+
+        # public branch: `public` blocks, each slot's committees vote for it
+        for slot in slots[:public]:
+            self.start_slot_steps(slot, epoch)
+            if self.canonical_block(slot, graffiti=b"public") is not None:
+                public_head_slot = slot
+            self.queue_votes(slot)
+
+        # private branch, built silently off the pre-storm head: the shared
+        # slots equivocate with the public proposers (same proposer, other
+        # graffiti); votes are only collected for the slots whose committees
+        # have NOT already voted public (sticky one-vote-per-epoch rule)
+        private_blocks, private_atts = [], []
+        private_state = fork_state
+        for slot in slots[:private]:
+            if self._slot_proposer_slashed(private_state, slot):
+                # slashed proposer holes the private branch too (the next
+                # built slot's process_slots absorbs the gap); its committees
+                # sit out — an empty slot offers no new head to vote for
+                self.stats["skipped_proposals"] += 1
+                continue
+            signed = _build_signed_block(
+                spec, private_state, slot, graffiti=b"storm")
+            private_blocks.append(self._register_block(signed))
+            if slot >= slots[0] + public:
+                from ..testlib.attestations import get_valid_attestations_at_slot
+                for att in get_valid_attestations_at_slot(
+                        spec, private_state, slot):
+                    if not self._vote_admissible(att):
+                        continue
+                    private_atts.append(self._register_att(att, private_state))
+                    self.stats["attestations"] += 1
+            if slot == slots[0] and public >= 1:
+                self.stats["equivocations"] += 1
+
+        # silent slots: ticks only — no public blocks, no public votes
+        for slot in slots[public:private]:
+            self.start_slot_steps(slot, epoch)
+
+        # release slot: the private branch + its banked votes land at once
+        release_slot = slots[private]
+        self.start_slot_steps(release_slot, epoch)
+        seg.steps.append({"probe": "storm_pre"})
+        for name in private_blocks:
+            seg.steps.append({"block": name})
+        for name in private_atts:
+            seg.steps.append({"attestation": name})
+        seg.steps.append({"probe": "storm_post"})
+        self._registry.counter("scenario_build_storms_total").inc()
+
+        # the reorg: private branch becomes canonical
+        self.state = private_state
+        self.chain = self.chain[:fork_chain_len] + private_blocks
+        if public_head_slot is not None:
+            depth = public_head_slot - (slots[0] - 1)
+            self.stats["planned_reorg_depth_max"] = max(
+                self.stats["planned_reorg_depth_max"], depth)
+
+        # re-converge: canonical blocks on the private branch to epoch end
+        self.canonical_block(release_slot)
+        self.queue_votes(release_slot)
+        for slot in slots[private + 1:]:
+            self.start_slot_steps(slot, epoch)
+            self.canonical_block(slot)
+            self.queue_votes(slot)

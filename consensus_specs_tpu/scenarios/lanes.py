@@ -1,0 +1,401 @@
+"""Three replay lanes over one materialized history, asserted bit-identical.
+
+A ScenarioHistory is a pure data script (ticks, blocks, attestations,
+checkpoints, probes). Each lane replays it through a fresh fork-choice
+store per segment and records the SAME observables at every checkpoint —
+`testlib.fork_choice.checks_snapshot` (head, justified/finalized,
+proposer boost) plus the head state's hash_tree_root — so convergence is
+a plain dict comparison (`assert_converged`):
+
+  oracle    pure-Python spec execution, no device, no faults — the truth.
+  engine    epoch transitions routed through the resident device bridge
+            (`bridge.apply_epoch_via_engine`) with the PR-5 chaos seams
+            live (robustness/schedules.long_horizon_plan "engine"): every
+            injected dispatch raise / torn aux readout must be absorbed by
+            retry → breaker → degrade without moving a single bit.
+  firehose  gossip attestations are admitted through a real
+            AttestationFirehose (ingest → dedup → sched flush) before the
+            store sees them, interleaved with adversarial traffic
+            (malformed payloads, duplicate offers) that must quarantine
+            without perturbing a verdict.
+
+Reorg accounting: `probe` and `checkpoint` steps sample get_head; a new
+head that does not descend from the previous sample is a reorg of depth
+(old head slot − common ancestor slot). The storm builder brackets each
+release with probes, so every lane measures the same flips.
+
+jax-free at module level by charter (analysis/layering.py): the engine
+bridge and scheduler are deferred imports inside the lanes that use them.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from random import Random
+
+from ..obs import metrics as _obs_metrics
+from ..testlib.fork_choice import checks_snapshot
+from .history import ScenarioHistory
+
+
+@dataclass
+class LaneResult:
+    """One lane's replay transcript — everything assert_converged compares."""
+
+    name: str
+    checkpoints: list           # [{"epoch", "fork", "head_state_root", "checks"}]
+    reorgs: int = 0
+    max_reorg_depth: int = 0
+    slots: int = 0
+    elapsed_s: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+
+def _reorg_depth(store, old_head, new_head) -> int:
+    """Depth of the head flip old→new: 0 when new descends from old, else
+    old head slot − common ancestor slot (parent walks over store.blocks)."""
+    if old_head == new_head:
+        return 0
+    ancestors = set()
+    root = new_head
+    while root in store.blocks:
+        ancestors.add(root)
+        parent = store.blocks[root].parent_root
+        if parent == root:
+            break
+        root = parent
+    if old_head in ancestors:
+        return 0
+    root = old_head
+    while root in store.blocks and root not in ancestors:
+        parent = store.blocks[root].parent_root
+        if parent == root:
+            break
+        root = parent
+    if root in ancestors:
+        return int(store.blocks[old_head].slot) - int(store.blocks[root].slot)
+    # disjoint trees (cannot happen for one store; belt for partial stores)
+    return int(store.blocks[old_head].slot) + 1
+
+
+@contextmanager
+def _null_router():
+    yield
+
+
+def replay_history(history: ScenarioHistory, *, name: str = "oracle",
+                   epoch_router=None, attestation_gate=None,
+                   registry=None) -> LaneResult:
+    """Replay every segment's steps through a fresh store; one LaneResult.
+
+    `epoch_router(spec)` — optional context-manager factory entered per
+    segment (the engine lane patches spec.process_epoch inside it).
+    `attestation_gate(spec, seg)` — optional per-segment factory returning
+    `gate(name, attestation)`, called before each gossip on_attestation
+    (the firehose lane verifies through the pipeline here); it must raise
+    to veto, and its verdict must agree with the oracle by construction.
+    """
+    from ..compiler import get_spec_with_overrides
+    from ..crypto import bls
+
+    reg = registry if registry is not None else _obs_metrics.REGISTRY
+    script = history.script
+    result = LaneResult(name=name, checkpoints=[])
+    prev_bls = bls.bls_active
+    bls.bls_active = False  # scenario traffic is stub-signed (history.py)
+    t0 = time.monotonic()
+    try:
+        for seg in history.segments:
+            spec = get_spec_with_overrides(
+                seg.fork, script.preset, seg.config_overrides)
+            store = spec.get_forkchoice_store(
+                seg.anchor_state.copy(), seg.anchor_block)
+            gate = (attestation_gate(spec, seg)
+                    if attestation_gate is not None else None)
+            router = (epoch_router(spec) if epoch_router is not None
+                      else _null_router())
+            with router:
+                sampled_head = None
+                for step in seg.steps:
+                    if "tick" in step:
+                        spec.on_tick(store, int(step["tick"]))
+                        result.slots += 1
+                    elif "block" in step:
+                        signed = seg.objects[step["block"]]
+                        spec.on_block(store, signed)
+                        # the reference's add_block contract: in-block
+                        # attestations feed the fork choice too, best-effort
+                        # (a fresh post-fork store rejects anchor-older
+                        # targets the state transition accepts)
+                        for att in signed.message.body.attestations:
+                            try:
+                                spec.on_attestation(store, att,
+                                                    is_from_block=True)
+                            except AssertionError:
+                                pass
+                        reg.counter("scenario_blocks_total", lane=name).inc()
+                    elif "attestation" in step:
+                        att = seg.objects[step["attestation"]]
+                        if gate is not None:
+                            gate(step["attestation"], att)
+                        spec.on_attestation(store, att)
+                        reg.counter(
+                            "scenario_attestations_total", lane=name).inc()
+                    else:  # probe / checkpoint: head samples
+                        head = spec.get_head(store)
+                        if sampled_head is not None:
+                            depth = _reorg_depth(store, sampled_head, head)
+                            if depth > 0:
+                                result.reorgs += 1
+                                result.max_reorg_depth = max(
+                                    result.max_reorg_depth, depth)
+                                reg.counter(
+                                    "scenario_reorgs_total", lane=name).inc()
+                                reg.gauge("scenario_reorg_depth_max",
+                                          lane=name).set(
+                                    result.max_reorg_depth)
+                        sampled_head = head
+                        if "checkpoint" in step:
+                            head, checks = checks_snapshot(spec, store)
+                            state_root = spec.hash_tree_root(
+                                store.block_states[head])
+                            result.checkpoints.append({
+                                "epoch": int(step["checkpoint"]),
+                                "fork": seg.fork,
+                                "head_state_root":
+                                    "0x" + bytes(state_root).hex(),
+                                "checks": checks,
+                            })
+                            reg.counter("scenario_checkpoints_total",
+                                        lane=name).inc()
+            if gate is not None and hasattr(gate, "finish"):
+                gate.finish(result)
+        result.elapsed_s = max(time.monotonic() - t0, 1e-9)
+        reg.histogram("scenario_slots_per_s", lane=name).observe(
+            result.slots / result.elapsed_s)
+        return result
+    finally:
+        bls.bls_active = prev_bls
+
+
+# -- lane: oracle -----------------------------------------------------------
+
+def oracle_lane(history: ScenarioHistory, *, registry=None) -> LaneResult:
+    """Pure-Python spec replay: the ground truth the others must match."""
+    return replay_history(history, name="oracle", registry=registry)
+
+
+# -- lane: engine (chaos on) -------------------------------------------------
+
+@contextmanager
+def _engine_epoch_router(spec):
+    """Route epoch transitions through the resident device bridge.
+
+    The bridge's degrade path calls `spec.process_epoch` itself (bridge.py
+    pre-commit failure handling), so the patch is removed AROUND each
+    bridge call — a degraded epoch runs the original, never recurses.
+    Phase0 states (no participation flags) stay on the pure path: the
+    engine's column layout is altair+.
+    """
+    from ..engine import bridge
+
+    original = spec.process_epoch
+
+    def routed(state):
+        if not hasattr(state, "previous_epoch_participation"):
+            return original(state)
+        spec.process_epoch = original
+        try:
+            bridge.apply_epoch_via_engine(spec, state)
+        finally:
+            spec.process_epoch = routed
+
+    spec.process_epoch = routed
+    try:
+        yield
+    finally:
+        spec.process_epoch = original
+
+
+def engine_lane(history: ScenarioHistory, *, registry=None,
+                fault_seed=None, fault_profile: str = "engine") -> LaneResult:
+    """Resident-engine replay with the long-horizon chaos drizzle live."""
+    from ..engine import bridge
+    from ..robustness.schedules import long_horizon_plan
+
+    seed = history.script.seed if fault_seed is None else fault_seed
+    plan = long_horizon_plan(seed, profile=fault_profile)
+    bridge.reset_device_breaker()
+    try:
+        with plan.active():
+            result = replay_history(
+                history, name="engine", epoch_router=_engine_epoch_router,
+                registry=registry)
+    finally:
+        bridge.reset_device_breaker()
+    result.extra["faults_fired"] = {
+        site: plan.fires(site) for site in sorted(plan.fired_sites())}
+    return result
+
+
+# -- lane: firehose -----------------------------------------------------------
+
+class _SwitchableBls:
+    """BlsWorkClass variant whose device path routes through crypto.bls's
+    switchable frontend — stub-signed scenario traffic then verifies
+    exactly as the oracle's on_attestation does (bls off → True), while a
+    real-signature run still checks for real. Collapse stays enabled, but
+    scenario committees sign distinct roots, so requests queue 1:1."""
+
+    def __new__(cls):
+        from ..sched import BlsWorkClass
+
+        class _Impl(BlsWorkClass):
+            def execute(self, requests):
+                return self.execute_degraded(requests)
+
+            def execute_degraded(self, requests):
+                import numpy as np
+
+                from ..crypto import bls
+                dispatch = {
+                    "verify": bls.Verify,
+                    "fast_aggregate": bls.FastAggregateVerify,
+                    "aggregate_verify": bls.AggregateVerify,
+                }
+                return np.asarray(
+                    [bool(dispatch[r.kind](*r.payload)) for r in requests],
+                    dtype=bool)
+
+        return _Impl(collapse_same_message=True)
+
+
+class _FirehoseGate:
+    """Admission gate: every gossip attestation passes through a real
+    firehose (classify → dedup → sched flush → verdict) before the store's
+    on_attestation. Classification is a pure lookup against the history's
+    att_keys table (the builder recorded pubkeys/signing-root per vote).
+    Adversarial extras — malformed payloads and duplicate offers, drawn
+    from a lane-local seeded stream — ride along in the offered traffic
+    only; they must quarantine/dedup without touching any verdict."""
+
+    def __init__(self, spec, seg, *, registry, seed, adversarial=True):
+        from ..firehose.ingest import AttestationItem, ClassifyError
+        from ..firehose.pipeline import AttestationFirehose, FirehoseConfig
+        from ..parallel.gossip_driver import message_id
+        from ..sched import Scheduler
+        from ..ssz import serialize
+
+        self._rng = Random(f"scenario:{seed}:firehose")
+        self._adversarial = adversarial
+        self._message_id = message_id
+        self.offered = self.malformed = self.duplicates = 0
+
+        self._raw: dict = {}
+        table: dict = {}
+        for att_name, keys in seg.att_keys.items():
+            att = seg.objects[att_name]
+            raw = bytes(serialize(att))
+            data = att.data
+            self._raw[att_name] = raw
+            table[raw] = AttestationItem(
+                msg_id=message_id(raw),
+                key=(int(data.slot), int(data.index),
+                     bytes(data.beacon_block_root)),
+                pubkeys=tuple(keys["pubkeys"]),
+                message=keys["message"],
+                signature=keys["signature"],
+                ssz=raw)
+
+        def classify(ssz_bytes: bytes):
+            item = table.get(bytes(ssz_bytes))
+            if item is None:
+                raise ClassifyError("payload is not a scenario attestation")
+            return item
+
+        # batch_attestations=1: every offer seals + flushes inline
+        # (threaded=False), so verdicts resolve deterministically in step
+        # order — the scenario contract replay depends on.
+        self._hose = AttestationFirehose(
+            classify,
+            config=FirehoseConfig(batch_attestations=1, max_pending=64,
+                                  flush_deadline_s=0.0),
+            scheduler=Scheduler(classes=[_SwitchableBls()],
+                                max_depth=1 << 30, registry=registry),
+            registry=registry, threaded=False)
+
+    def __call__(self, att_name, attestation):
+        raw = self._raw[att_name]
+        if self._adversarial and self._rng.random() < 0.05:
+            # malformed gossip frame: must quarantine, not verify
+            junk = self._rng.randbytes(self._rng.randrange(1, 64))
+            assert not self._hose.offer(junk)
+            self.malformed += 1
+        if self._adversarial and self._rng.random() < 0.05:
+            # duplicate offer ahead of the real one: dedup admits only one
+            self._hose.offer(raw)
+            self.duplicates += 1
+        self._hose.offer(raw)
+        self.offered += 1
+        self._hose.drain(timeout_s=30.0)
+        verdict = self._hose.results().get(self._message_id(raw))
+        assert verdict is True, (
+            f"firehose rejected scenario attestation {att_name}")
+
+    def finish(self, result: LaneResult) -> None:
+        self._hose.drain(timeout_s=30.0)
+        stats = result.extra.setdefault(
+            "firehose", {"offered": 0, "malformed": 0, "duplicates": 0})
+        stats["offered"] += self.offered
+        stats["malformed"] += self.malformed
+        stats["duplicates"] += self.duplicates
+
+
+def firehose_lane(history: ScenarioHistory, *, registry=None,
+                  adversarial: bool = True, fault_seed=None,
+                  chaos: bool = False) -> LaneResult:
+    """Streaming replay: gossip votes verified through the firehose/sched
+    path before admission. `chaos=True` additionally drizzles transient
+    faults over the ingest/flush seams (retried inside the pipeline)."""
+    from ..robustness.schedules import long_horizon_plan
+
+    reg = registry if registry is not None else _obs_metrics.REGISTRY
+    script = history.script
+
+    def gate_factory(spec, seg):
+        return _FirehoseGate(spec, seg, registry=reg, seed=script.seed,
+                             adversarial=adversarial)
+
+    if chaos:
+        seed = script.seed if fault_seed is None else fault_seed
+        with long_horizon_plan(seed, profile="firehose").active():
+            return replay_history(history, name="firehose",
+                                  attestation_gate=gate_factory,
+                                  registry=reg)
+    return replay_history(history, name="firehose",
+                          attestation_gate=gate_factory, registry=reg)
+
+
+# -- convergence --------------------------------------------------------------
+
+def assert_converged(results: list) -> None:
+    """Every lane must agree bit-identically on every checkpoint — state
+    roots, heads, justified/finalized checkpoints, boost — and on the
+    reorg transcript (count + max depth)."""
+    assert results, "no lanes to compare"
+    base = results[0]
+    for other in results[1:]:
+        assert len(other.checkpoints) == len(base.checkpoints), (
+            f"{other.name}: {len(other.checkpoints)} checkpoints vs "
+            f"{base.name}: {len(base.checkpoints)}")
+        for i, (a, b) in enumerate(zip(base.checkpoints, other.checkpoints)):
+            assert a == b, (
+                f"checkpoint {i} diverged: {base.name}={a!r} "
+                f"{other.name}={b!r}")
+        assert other.reorgs == base.reorgs, (
+            f"reorg count diverged: {base.name}={base.reorgs} "
+            f"{other.name}={other.reorgs}")
+        assert other.max_reorg_depth == base.max_reorg_depth, (
+            f"reorg depth diverged: {base.name}={base.max_reorg_depth} "
+            f"{other.name}={other.max_reorg_depth}")

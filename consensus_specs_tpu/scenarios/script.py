@@ -1,0 +1,120 @@
+"""Seeded scenario scripts: WHAT happens each epoch, decided up front.
+
+The script layer is pure planning — stdlib `random.Random` seeded with
+`f"scenario:{seed}"` (the robustness/faults.py per-site stream idiom), no
+spec objects, no jax. `build_history` materializes a script into SSZ
+objects; keeping the planner separate means the seed→plan mapping is
+stable even as the materializer grows new mechanics, which is the
+seed/replay contract the vector emitter depends on (same seed, same
+tree, byte-identical — tests/test_scenarios.py double-render check).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from random import Random
+
+# Epoch event kinds, in escalation order. `calm` epochs carry full-committee
+# in-block attestations (justification/finality keeps advancing); everything
+# else trades some liveness for adversarial structure.
+CALM = "calm"
+DROUGHT = "drought"                # empty-slot stretches, gossip-only votes
+REORG_STORM = "reorg_storm"        # private branch released late, head flips
+EQUIVOCATION = "equivocation_ladder"  # double proposals + proposer slashings
+SLASHING_WAVE = "slashing_wave"    # attester double-vote, committee slashed
+
+EVENT_KINDS = (CALM, DROUGHT, REORG_STORM, EQUIVOCATION, SLASHING_WAVE)
+
+
+@dataclass
+class EpochPlan:
+    """One epoch's event assignment."""
+
+    epoch: int
+    kind: str
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class ScenarioScript:
+    """The full seeded plan for one scenario run."""
+
+    seed: int
+    preset: str
+    forks: tuple            # ("phase0", "altair") — pre fork, post fork
+    fork_epoch: int         # epoch at which forks[1] activates
+    epochs: int             # total scenario length in epochs
+    plans: list             # [EpochPlan] * epochs
+
+    @property
+    def name(self) -> str:
+        return f"seed_{self.seed}_epochs_{self.epochs}_fork_{self.fork_epoch}"
+
+    def plan_for(self, epoch: int) -> EpochPlan:
+        return self.plans[epoch]
+
+
+def build_script(seed: int, *, epochs: int = 8, preset: str = "minimal",
+                 forks: tuple = ("phase0", "altair"), fork_epoch: int = 2,
+                 max_slashing_waves: int = 2,
+                 max_equivocation_epochs: int = 4) -> ScenarioScript:
+    """Compose a seeded epoch-by-epoch plan.
+
+    Guard rails the materializer relies on:
+      * epoch 0 and the epochs around the fork boundary are calm (the
+        store needs an attested base before a storm can flip heads, and
+        the fork handoff anchors a fresh store from the canonical chain);
+      * the two epochs AFTER the post-fork anchor are also calm:
+        get_forkchoice_store pins the fresh store's justified/finalized
+        checkpoints to (anchor_epoch, anchor_root), and filter_block_tree
+        compares descendant STATES against those by equality (the only
+        escape is GENESIS_EPOCH, which a mid-history anchor forfeits) —
+        in-state finality needs two consecutive justified epochs to
+        realize (anchor_epoch, anchor_root) and unstick the head walk;
+      * slashing waves are budgeted — each wave burns a whole committee
+        (~1/16 of the default 64-validator world), and an over-slashed
+        set starves proposer selection;
+      * storm depth (private-branch length) and release split are chosen
+        so the late branch strictly outweighs the public one under
+        LMD-GHOST's one-sticky-vote-per-epoch rule (history._storm_epoch).
+    """
+    if epochs < 2:
+        raise ValueError("a scenario needs at least 2 epochs")
+    if not (0 < fork_epoch < epochs):
+        raise ValueError("fork_epoch must fall inside the scenario")
+    rng = Random(f"scenario:{seed}")
+    slashing_budget = max_slashing_waves
+    equivocation_budget = max_equivocation_epochs
+    plans = []
+    for epoch in range(epochs):
+        boundary = epoch in (
+            0, fork_epoch - 1, fork_epoch, fork_epoch + 1, fork_epoch + 2)
+        if boundary:
+            plans.append(EpochPlan(epoch, CALM))
+            continue
+        kind = rng.choices(
+            EVENT_KINDS, weights=(0.34, 0.16, 0.25, 0.15, 0.10))[0]
+        if kind == SLASHING_WAVE and slashing_budget <= 0:
+            kind = CALM
+        if kind == EQUIVOCATION and equivocation_budget <= 0:
+            kind = DROUGHT
+        params: dict = {}
+        if kind == DROUGHT:
+            # which in-epoch slots go blockless (never all: the epoch must
+            # keep a spine so attestation targets stay resolvable)
+            params["skip_every"] = rng.choice((2, 3))
+        elif kind == REORG_STORM:
+            # public branch runs `public` blocks, private branch `private`
+            # blocks; private > 2*public guarantees the weight flip
+            public = rng.choice((1, 2))
+            params["public"] = public
+            params["private"] = public * 2 + rng.choice((1, 2))
+        elif kind == EQUIVOCATION:
+            params["rungs"] = rng.choice((1, 2))
+            equivocation_budget -= 1
+        elif kind == SLASHING_WAVE:
+            params["attester"] = True
+            slashing_budget -= 1
+        plans.append(EpochPlan(epoch, kind, params))
+    return ScenarioScript(
+        seed=seed, preset=preset, forks=tuple(forks),
+        fork_epoch=fork_epoch, epochs=epochs, plans=plans)

@@ -1485,7 +1485,17 @@ class Container(SSZType):
         return new
 
     def __eq__(self, other):
-        return type(self) is type(other) and all(
+        # Structural, not nominal (remerkleable parity): every compiled
+        # fork/preset spec module defines its own Container classes, and
+        # cross-fork spec code compares values across that boundary — e.g.
+        # upgrade_to_altair's translate_participation matches a phase0
+        # attestation's `data.source` against the post state's checkpoint.
+        if type(self) is not type(other):
+            if not isinstance(other, Container):
+                return NotImplemented
+            if list(self.fields()) != list(other.fields()):
+                return False
+        return all(
             getattr(self, n) == getattr(other, n) for n in self.fields()
         )
 

@@ -1,0 +1,48 @@
+"""Scenario-engine vector generator: seeded long-horizon histories
+emitted from the TPU lane (the chaos-enabled engine replay supplies the
+fork-choice checks payloads) into the reference
+<preset>/<fork>/<runner>/<handler> tree — runners fork_choice/scenario
+and sanity/blocks per segment.
+
+Usage: python main.py -o <output_dir> [-f] [--seeds 1,2] [--epochs 8]
+"""
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))  # repo root
+
+from consensus_specs_tpu.scenarios import (
+    build_history,
+    build_script,
+    emit_history,
+    engine_lane,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-o", "--output-dir", required=True)
+    ap.add_argument("-f", "--force", action="store_true")
+    ap.add_argument("--seeds", default="1,2",
+                    help="comma-separated scenario seeds")
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--smoke", type=int, default=None, metavar="N",
+                    help="stop after N generated cases (the default-lane "
+                         "generator health probe)")
+    args = ap.parse_args(argv)
+    for seed in (int(s) for s in args.seeds.split(",") if s):
+        script = build_script(seed, epochs=args.epochs)
+        history = build_history(script)
+        lane = engine_lane(history, fault_seed=seed)
+        for rel in emit_history(history, Path(args.output_dir),
+                                lane_result=lane, force=args.force,
+                                smoke=args.smoke):
+            print(f"  {rel}")
+        if args.smoke is not None:
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

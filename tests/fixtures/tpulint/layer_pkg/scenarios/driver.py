@@ -1,0 +1,16 @@
+"""Clean scenario driver: jax-free at module level, matching the
+scenarios/ charter — the oracle lane never touches the device, and the
+engine/firehose lanes reach it only through deferred imports inside the
+lane bodies (bridge routing, sched work classes)."""
+
+checkpoints = []
+
+
+def replay(history, use_engine=False):
+    for seg in history:
+        if use_engine:
+            import jax  # deferred: only the engine lane pays
+
+            seg = jax.device_get(seg)
+        checkpoints.append(seg)
+    return list(checkpoints)

@@ -97,10 +97,12 @@ def test_layering_fixture():
     assert "bad_hooks.py" in by_file  # obs/ module-level jax.monitoring
     assert "bad_dispatch.py" in by_file  # sched/ module-level jax
     assert "bad_stream.py" in by_file  # firehose/ module-level jax
+    assert "bad_driver.py" in by_file  # scenarios/ module-level jax
     for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
                   "recompile.py",  # recompile: obs install-deferral pattern
                   "queue.py",  # sched: executor-deferral pattern
-                  "stream.py"):  # firehose: host-orchestrator pattern
+                  "stream.py",  # firehose: host-orchestrator pattern
+                  "driver.py"):  # scenarios: lane-deferral pattern
         assert clean not in by_file
 
 

@@ -93,6 +93,9 @@ def check_e2e_lane() -> int:
     rc = check_firehose_lane(extra)
     if rc:
         return rc
+    rc = check_scenario_lane(extra)
+    if rc:
+        return rc
     return check_obs_snapshot()
 
 
@@ -139,6 +142,31 @@ def check_firehose_lane(extra: dict) -> int:
     print(f"# bench-probe: firehose lane present "
           f"(steady={extra['firehose_atts_per_s_steady']}/s, "
           f"collapse={extra['firehose_collapse_ratio']})", file=sys.stderr)
+    return 0
+
+
+def check_scenario_lane(extra: dict) -> int:
+    """Refuse a record without the scenario-engine SLO lane: slots/s is
+    the long-horizon replay headline, the reorg depth proves the storm
+    machinery actually flipped heads, and the emitted/diffed vector
+    counts are the bidirectional-conformance evidence (emit from the
+    engine lane, diff byte-identical). A bench that dropped the lane
+    would keep reporting per-epoch numbers as if multi-thousand-slot
+    histories were still proven convergent."""
+    missing = [k for k in ("scenario_slots_per_s", "scenario_reorg_depth_max",
+                           "scenario_vectors_emitted",
+                           "scenario_vectors_diffed")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"scenario-engine lane (missing {missing}); fix "
+              f"benches/scenario_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: scenario lane present "
+          f"(slots/s={extra['scenario_slots_per_s']}, "
+          f"reorg_depth={extra['scenario_reorg_depth_max']}, "
+          f"vectors={extra['scenario_vectors_emitted']})", file=sys.stderr)
     return 0
 
 
