@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched firehose scenarios lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched msm firehose scenarios lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -61,6 +61,20 @@ sched:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_sched.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_sched.json
+
+# Pippenger MSM lane: the bucket-MSM kernel's cost pins (eval_shape loop
+# counts, point-op budget), host-oracle equivalence on edge batches, the
+# sched "msm" work class (compile-per-bucket pin, chaos corrupt faults,
+# 2G2T self-check), and the cold-lane committee aggregation regression —
+# see README "Pippenger MSM". Obs snapshot validated like the sibling
+# lanes; the msm-class sched_* and bls_pubkey_*_device series are the
+# artifact.
+msm:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_msm.json OBS_SNAPSHOT_LANE=msm \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_msm.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_msm.json
 
 # Attestation firehose lane: the streaming gossip->aggregate->flush
 # service (ingest dedup, committee collapse, double-buffered flush,

@@ -216,6 +216,10 @@ def run_benches() -> dict:
             import benches.kzg_bench as kzg_bench
 
             kzg_r = kzg_bench.run()
+        with timed("bench_msm"):
+            import benches.msm_bench as msm_bench
+
+            msm_r = msm_bench.run()
         with timed("bench_sync_aggregate"):
             import benches.sync_aggregate_bench as sync_bench
 
@@ -298,6 +302,15 @@ def run_benches() -> dict:
             "kzg_blobs_per_s": kzg_r["blobs_per_s"],
             "kzg_batch_verify_s": kzg_r["batch_verify_s"],
             "kzg_blobs": kzg_r["blobs"],
+            # Pippenger bucket-MSM kernel vs the per-item ladder it replaced
+            # (same points/scalars, cross-checked before timing); the sweep
+            # grid rides in msm_sweep
+            "msm_items_per_s": msm_r["msm_items_per_s"],
+            "msm_vs_ladder_speedup": msm_r["msm_vs_ladder_speedup"],
+            "msm_n": msm_r["msm_n"],
+            "msm_window": msm_r["msm_window"],
+            "msm_nbits": msm_r["msm_nbits"],
+            "msm_sweep": msm_r["msm_sweep"],
             # BASELINE config 3: per-block sync-aggregate obligation — one
             # 512-member FastAggregateVerify per block, flushed as a stream
             "sync_aggregate_blocks_per_s": sync_r["blocks_per_s_cold"],
@@ -408,6 +421,9 @@ def main() -> None:
         N_VALIDATORS = min(N_VALIDATORS, CPU_DEBUG_VALIDATORS)
         N_BLS = min(N_BLS, CPU_DEBUG_BLS)
         os.environ.setdefault("BENCH_ATT_VALIDATORS", "4096")
+        # msm sweep: one grid cell (XLA compiles of the 255-bit programs
+        # dominate on CPU; the items/s ratio is what's measured)
+        os.environ.setdefault("BENCH_MSM_N", "64")
         # sync-aggregate stream: fewer blocks (host signing + the pairing
         # compile dominate on CPU; the per-block rate is what's measured)
         os.environ.setdefault("BENCH_SYNC_BLOCKS", "8")

@@ -143,11 +143,27 @@ def run(counts: dict | None = None) -> dict:
         return dt
 
     # cold: every crypto cache (pubkey/signature decompression, committee
-    # aggregation, hash-to-curve, sign) empty, device compile included
+    # aggregation, hash-to-curve, sign) empty, device compile included.
+    # First-sighting committee aggregation must route through the device
+    # MSM lane (batched subgroup checks + g1_aggregate_device via the sched
+    # "msm" class) — the counters live on the process registry, so snapshot
+    # around the round and FAIL the bench if the cold lane fell back to the
+    # host pt_add loop.
+    glob = obs_metrics.REGISTRY
+    agg_dev_before = glob.counter_value("bls_pubkey_aggregate_device_total")
+    sub_dev_before = glob.counter_value("bls_pubkey_subgroup_device_total")
     bls.clear_caches()
     cold_dt = round_run(obs_metrics.MetricsRegistry())
-    print(f"# firehose cold round (compile included): {cold_dt:.1f}s",
-          file=sys.stderr)
+    agg_dev_cold = (glob.counter_value("bls_pubkey_aggregate_device_total")
+                    - agg_dev_before)
+    sub_dev_cold = (glob.counter_value("bls_pubkey_subgroup_device_total")
+                    - sub_dev_before)
+    assert agg_dev_cold > 0, (
+        "cold-lane committee aggregation did not route through the device "
+        "MSM path (bls_pubkey_aggregate_device_total never ticked)")
+    print(f"# firehose cold round (compile included): {cold_dt:.1f}s — "
+          f"{agg_dev_cold} device aggregations, {sub_dev_cold} device "
+          f"subgroup checks", file=sys.stderr)
 
     # steady state: re-sighting rounds — fresh firehose (dedup reset), hot
     # process caches; the histogram below aggregates only these rounds
@@ -162,6 +178,10 @@ def run(counts: dict | None = None) -> dict:
     return {
         "firehose_atts_per_s_cold": round(n_atts / cold_dt, 1),
         "firehose_atts_per_s_steady": round(n_atts / best, 1),
+        # cold-lane device routing evidence: committee aggregations and
+        # cold pubkey subgroup checks served by the MSM lane this run
+        "firehose_agg_device_committees": int(agg_dev_cold),
+        "firehose_subgroup_device_keys": int(sub_dev_cold),
         "firehose_p99_ingest_to_verified_s": round(hist.p99(), 4),
         "firehose_p50_ingest_to_verified_s": round(hist.p50(), 4),
         # attestations per device pairing check, measured across the steady

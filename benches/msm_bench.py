@@ -1,0 +1,161 @@
+"""Pippenger bucket-MSM lane: the device Σ scalar_i·P_i kernel vs the
+per-item double-and-add ladder it replaced (PR 11).
+
+Measured region: the jitted MSM program (`ops/bls12_jax._g1_msm_program`)
+on device-resident inputs, best of 3 after a compile+correctness pass —
+the same framing bench_bls uses for the pairing kernels. The ladder
+composite (per-item `g1_scalar_mul_batch` + `g1_sum_reduce`, jitted here
+exactly as crypto/kzg_batch ran it through PR 10) runs on the SAME points
+and scalars, so the speedup column is apples-to-apples: identical inputs,
+identical reduction semantics, both verified against each other before
+timing. Host prep (Montgomery encoding, bit decomposition) is excluded —
+it is shared by both paths and amortized across the sweep.
+
+Sweep: BENCH_MSM_N (comma list of item counts, default "128") ×
+BENCH_MSM_WINDOWS (comma list of window widths, default "4") at
+BENCH_MSM_NBITS scalar bits (default 255 — the KZG folded-side shape).
+Each grid cell also reports the shape-derived batched point-op counts
+(g1_msm_point_ops / g1_ladder_point_ops), the analytically pinned claim
+behind the measured ratio.
+
+Usage: python benches/msm_bench.py — one JSON line.
+"""
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def default_grid() -> dict:
+    return {
+        "ns": [int(x) for x in
+               os.environ.get("BENCH_MSM_N", "128").split(",")],
+        "windows": [int(x) for x in
+                    os.environ.get("BENCH_MSM_WINDOWS", "4").split(",")],
+        "nbits": int(os.environ.get("BENCH_MSM_NBITS", 255)),
+        "reps": int(os.environ.get("BENCH_MSM_REPS", 3)),
+    }
+
+
+def _affine_of(jac) -> tuple | None:
+    """Host-normalize one device Jacobian point for the cross-check."""
+    import numpy as np
+
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    unmont = lambda v: K.F.from_mont_int(
+        np.asarray(v).reshape(-1, K.F.NLIMBS)[0])
+    xj, yj, zj = (unmont(c) for c in jac)
+    if zj == 0:
+        return None
+    zinv = pow(zj, K.P - 2, K.P)
+    return (xj * zinv * zinv % K.P, yj * zinv * zinv * zinv % K.P)
+
+
+def run(grid: dict | None = None) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consensus_specs_tpu.crypto import bls12_381 as oracle
+    from consensus_specs_tpu.ops import bls12_jax as K
+
+    if grid is None:
+        grid = default_grid()
+    nbits, reps = grid["nbits"], grid["reps"]
+
+    @jax.jit
+    def ladder_msm(X, Y, Z, bits):
+        return K.g1_sum_reduce(K.g1_scalar_mul_batch((X, Y, Z), bits))
+
+    n_max = max(grid["ns"])
+    t0 = time.time()
+    points = []
+    acc = oracle.G1_GEN
+    for _ in range(n_max):
+        points.append(oracle.pt_to_affine(oracle.FP_FIELD, acc))
+        acc = oracle.pt_add(oracle.FP_FIELD, acc, oracle.G1_GEN)
+    scalars = [pow(5, i + 1, oracle.R) % (1 << nbits) for i in range(n_max)]
+    print(f"# msm host prep ({n_max} points): {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    sweep = []
+    compile_s = 0.0
+    for n in sorted(grid["ns"]):
+        enc = K.F.ints_to_mont_batch
+        X = jnp.asarray(enc([p[0] for p in points[:n]]))
+        Y = jnp.asarray(enc([p[1] for p in points[:n]]))
+        Z = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), X.shape).astype(X.dtype)
+        bits = jnp.asarray(K._scalar_bits_lsb(scalars[:n], nbits))
+
+        t0 = time.time()
+        lad = ladder_msm(X, Y, Z, bits)
+        jax.block_until_ready(lad)
+        compile_s += time.time() - t0
+        lad_aff = _affine_of(jax.device_get(lad))
+        lad_times = []
+        for _ in range(reps):
+            t0 = time.time()
+            jax.block_until_ready(ladder_msm(X, Y, Z, bits))
+            lad_times.append(time.time() - t0)
+
+        for w in sorted(grid["windows"]):
+            t0 = time.time()
+            out = K._g1_msm_program(X, Y, Z, bits, w)
+            jax.block_until_ready(out)
+            compile_s += time.time() - t0
+            msm_aff = _affine_of(jax.device_get(out))
+            assert msm_aff == lad_aff, (
+                f"MSM/ladder disagree at n={n} w={w}")
+            msm_times = []
+            for _ in range(reps):
+                t0 = time.time()
+                jax.block_until_ready(K._g1_msm_program(X, Y, Z, bits, w))
+                msm_times.append(time.time() - t0)
+            sweep.append({
+                "n": n, "window": w, "nbits": nbits,
+                "msm_items_per_s": round(n / min(msm_times), 1),
+                "ladder_items_per_s": round(n / min(lad_times), 1),
+                "speedup": round(min(lad_times) / min(msm_times), 2),
+                "point_ops_msm": K.g1_msm_point_ops(n, nbits, w),
+                "point_ops_ladder": K.g1_ladder_point_ops(n, nbits),
+            })
+            print(f"# msm n={n} w={w}: {sweep[-1]}", file=sys.stderr)
+
+    # headline cell: largest n at the default window (or the first swept)
+    head_w = (K.MSM_WINDOW if K.MSM_WINDOW in grid["windows"]
+              else sorted(grid["windows"])[0])
+    head = next(c for c in reversed(sweep)
+                if c["n"] == max(grid["ns"]) and c["window"] == head_w)
+    return {
+        "msm_items_per_s": head["msm_items_per_s"],
+        "msm_ladder_items_per_s": head["ladder_items_per_s"],
+        "msm_vs_ladder_speedup": head["speedup"],
+        "msm_n": head["n"],
+        "msm_window": head["window"],
+        "msm_nbits": nbits,
+        "msm_compile_s": round(compile_s, 1),
+        "msm_sweep": sweep,
+    }
+
+
+def main():
+    from consensus_specs_tpu.utils.backend import enable_compile_cache, force_cpu
+
+    force_cpu()
+    enable_compile_cache()
+    r = run()
+    print(json.dumps({
+        "metric": "msm_items_per_s",
+        "value": r["msm_items_per_s"],
+        "unit": "msm terms/sec/chip",
+        "vs_baseline": None,
+        "extra": r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

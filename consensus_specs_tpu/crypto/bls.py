@@ -282,6 +282,7 @@ def clear_caches() -> None:
     if bls_jax is None:
         return
     bls_jax._AGG_CACHE.clear()
+    bls_jax._PK_VALIDATED.clear()
     bls_jax.g1_from_bytes.cache_clear()
     bls_jax.g2_from_bytes.cache_clear()
     bls_jax.hash_to_curve_g2.cache_clear()

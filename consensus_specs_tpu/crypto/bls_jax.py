@@ -137,6 +137,15 @@ def make_verify_check(pubkey, message, signature) -> QueuedCheck | None:
 _AGG_CACHE: dict = {}
 _AGG_CACHE_MAX = 1 << 12
 
+# Device-validated pubkeys: compressed bytes -> affine pair, populated by
+# the batched device subgroup check in _aggregate_pubkeys_device_impl.
+# Kept separate from the g1_from_bytes lru_cache because an lru_cache can
+# only be filled by the wrapped call — and that call is exactly the host
+# 255-bit pt_mul this lane exists to avoid. Bounded FIFO; entries are the
+# same ~0.5 KB as g1_from_bytes's.
+_PK_VALIDATED: dict = {}
+_PK_VALIDATED_MAX = 1 << 16
+
 
 def _aggregate_pubkeys_affine(pubkeys_bytes: list):
     """Affine sum of compressed pubkeys (None for an infinity sum);
@@ -152,6 +161,18 @@ def _aggregate_pubkeys_affine(pubkeys_bytes: list):
     if hit is not None:
         _AGG_CACHE[key] = hit
         return hit
+    if len(pubkeys_bytes) >= DEVICE_AGGREGATE_MIN:
+        marker = _aggregate_pubkeys_sched(pubkeys_bytes)
+        if marker is not None:
+            if marker[0] == "bad_encoding":
+                raise ValueError(marker[1])
+            if marker[0] in ("inf_member", "inf"):
+                return None  # invalid/degenerate input: never cached
+            agg = (marker[1], marker[2])
+            if len(_AGG_CACHE) >= _AGG_CACHE_MAX:
+                _AGG_CACHE.pop(next(iter(_AGG_CACHE)))
+            _AGG_CACHE[key] = agg
+            return agg
     acc = None
     for pk in pubkeys_bytes:
         aff = g1_from_bytes(pk)
@@ -164,6 +185,76 @@ def _aggregate_pubkeys_affine(pubkeys_bytes: list):
         _AGG_CACHE.pop(next(iter(_AGG_CACHE)))
     _AGG_CACHE[key] = agg
     return agg
+
+
+def _aggregate_pubkeys_sched(pubkeys_bytes: list):
+    """Submit one committee aggregate to the sched "msm" work class and
+    return its marker tuple, or None when the lane is unavailable (the
+    class is not registered on the default scheduler — e.g. a test
+    scheduler built from a trimmed class list). Nested submits are safe:
+    the scheduler's lock is re-entrant, so this works from inside a BLS
+    flush that is itself being served through sched."""
+    from .. import sched as _sched
+
+    sch = _sched.default_scheduler()
+    if "msm" not in sch.classes:
+        return None
+    h = sch.submit(_sched.Request(
+        work_class="msm", kind="aggregate", payload=tuple(pubkeys_bytes)))
+    return h.result()
+
+
+def _aggregate_pubkeys_device_impl(pubkeys_bytes: list):
+    """Device committee aggregation — the "aggregate" kind behind the sched
+    msm class. Returns a marker tuple instead of raising, so the scheduler
+    seam can carry the outcome through its object-dtype result rows:
+
+        ("point", x, y)        affine aggregate (ints mod p)
+        ("inf",)               the sum is the identity
+        ("inf_member",)        an infinity pubkey appeared (invalid input)
+        ("bad_encoding", msg)  decompression / subgroup rejection
+
+    Keys never seen before decompress WITHOUT the host 255-bit subgroup
+    pt_mul (bls12_381.py:590) and are validated in ONE batched device
+    ladder ([r]P == inf via g1_subgroup_check_device) — the firehose cold
+    lane's dominant cost (one ~4 ms host check per member, ~2.7 s per
+    488-member committee) collapses to a single bucketed kernel launch.
+    The sum itself is the all-ones-scalar MSM degenerate case: a plain
+    masked reduction tree (g1_aggregate_device), no windows needed."""
+    from ..ops import bls12_jax as K
+
+    reg = _obs_metrics.REGISTRY
+    affs: list = []
+    cold_idx: list = []
+    try:
+        for i, pk in enumerate(pubkeys_bytes):
+            pk = bytes(pk)
+            hit = _PK_VALIDATED.get(pk)
+            if hit is not None:
+                affs.append(hit)
+                continue
+            aff = oracle.g1_from_bytes(pk, subgroup_check=False)
+            if aff is None:
+                return ("inf_member",)
+            affs.append(aff)
+            cold_idx.append(i)
+    except ValueError as e:
+        return ("bad_encoding", str(e))
+    if cold_idx:
+        ok = K.g1_subgroup_check_device([affs[i] for i in cold_idx])
+        if not bool(ok.all()):
+            return ("bad_encoding", "G1 point not in r-subgroup")
+        for i in cold_idx:
+            if len(_PK_VALIDATED) >= _PK_VALIDATED_MAX:
+                _PK_VALIDATED.pop(next(iter(_PK_VALIDATED)))
+            _PK_VALIDATED[bytes(pubkeys_bytes[i])] = affs[i]
+        reg.counter("bls_pubkey_subgroup_device_total").inc(len(cold_idx))
+    total = K.g1_aggregate_device(affs)
+    reg.counter("bls_pubkey_aggregate_device_total").inc()
+    reg.counter("bls_pubkey_aggregate_device_keys_total").inc(len(affs))
+    if total is None:
+        return ("inf",)
+    return ("point", total[0], total[1])
 
 
 def make_fast_aggregate_check(pubkeys, message, signature) -> QueuedCheck | None:
@@ -470,35 +561,26 @@ DEVICE_AGGREGATE_MIN = 32  # below this, host point-adds beat a kernel launch
 
 
 def aggregate_pubkeys_device(pubkeys) -> bytes:
-    """Aggregate compressed G1 pubkeys via the device reduction tree
-    (ops/bls12_jax.g1_sum_reduce — the SURVEY §2.3 G1-collective component).
+    """Aggregate compressed G1 pubkeys on device, routed through the sched
+    "msm" work class (shape-bucketed dispatch, bounded admission, breaker
+    degradation to the host oracle) with batched device subgroup checks for
+    cold keys and the g1_aggregate_device reduction tree underneath.
 
     Raises ValueError on any invalid/infinity input, mirroring the host
-    oracle's AggregatePKs contract."""
-    import jax.numpy as jnp
-
-    from ..ops import bls12_jax as K
+    oracle's AggregatePKs contract; an infinity SUM encodes as 0xc0."""
+    from .bls12_381 import g1_to_bytes
 
     if len(pubkeys) == 0:
         raise ValueError("aggregate of empty pubkey list")
-    affs = []
-    for pk in pubkeys:
-        aff = g1_from_bytes(bytes(pk))
-        if aff is None:
-            raise ValueError("infinity pubkey in aggregate")
-        affs.append(aff)
-    enc = K.F.ints_to_mont_batch
-    X = jnp.asarray(enc([a[0] for a in affs]))
-    Y = jnp.asarray(enc([a[1] for a in affs]))
-    Z = jnp.broadcast_to(jnp.asarray(K.F.ONE_MONT), X.shape)
-    total = K.g1_sum_reduce((X, Y, Z))
-    import numpy as np
-
-    from .bls12_381 import g1_to_bytes
-
-    if bool(np.asarray(K.F.fp_is_zero(total[2]))):
+    pks = [bytes(pk) for pk in pubkeys]
+    marker = _aggregate_pubkeys_sched(pks)
+    if marker is None:  # msm lane unavailable: run the device impl inline
+        marker = _aggregate_pubkeys_device_impl(pks)
+    tag = marker[0]
+    if tag == "bad_encoding":
+        raise ValueError(marker[1])
+    if tag == "inf_member":
+        raise ValueError("infinity pubkey in aggregate")
+    if tag == "inf":
         return g1_to_bytes(None)  # sum is infinity: canonical 0xc0 encoding
-    sx, sy = K.g1_to_affine(total)
-    x = K.F.from_mont_int(np.asarray(sx))
-    y = K.F.from_mont_int(np.asarray(sy))
-    return g1_to_bytes((x, y))
+    return g1_to_bytes((marker[1], marker[2]))

@@ -1222,3 +1222,273 @@ def rlc_tail(m1, m2_single):
     prod = f12_prod_reduce(m1)
     single = tuple((c[0][0], c[1][0]) for c in prod)
     return f12_is_one(final_exponentiation_batch(f12_mul(single, m2_single)))
+
+
+# --- Pippenger bucket-MSM ---------------------------------------------------
+#
+# One multi-scalar multiplication Σ_i [s_i]·P_i for every G1 hot path that
+# used to pay a per-item double-and-add ladder: the KZG batch verifier's
+# 255-bit coefficient fold (crypto/kzg_batch), committee pubkey aggregation
+# (crypto/bls_jax via the sched "msm" work class), and standalone MSM
+# requests. Scalars split into w-bit windows; each (item, window) digit d
+# selects the bucket multiple [d]·P_i out of a per-item table; the window
+# sums reduce with the SAME masked tree machinery as g1_segment_sum (no
+# scatter — the tpulint rule that shaped PR 3's grouped RLC); windows
+# combine Horner-style with w doublings per step.
+#
+# Why the gather form: textbook Pippenger scatters points into 2^w-1
+# buckets then folds them with a running sum, Σ_k k·B_k. On a scatter-free
+# backend the bucket accumulation would need one masked tree lane per
+# bucket per window ((N-1)·(2^w-1)·W adds) — strictly MORE work than the
+# ladder it replaces. Exchanging the summation order,
+#     Σ_k k·(Σ_{i: d_i=k} P_i)  ==  Σ_i [d_i]·P_i,
+# turns the scatter into a digit-indexed GATHER from the per-item bucket
+# table, so the tree pays one lane per (item, window) instead: N·(2^w-2)
+# table ops + (N-1)·W tree adds + (W-1)·(w+1) Horner ops, vs the 2-bit
+# ladder's N·(3·ceil(b/2) - 1). At the KZG shape (N=128, b=255, w=4) that
+# is ~10.2k point ops vs ~49k — the O(b·n/w) claim with the constant
+# actually below the ladder's, which the masked-bucket literal form never
+# achieves (see g1_msm_point_ops / g1_ladder_point_ops, pinned by
+# tests/test_msm.py the same way tests/test_rlc_grouped.py pins D+1).
+
+MSM_WINDOW = 4  # default window width; 2^w per-item bucket-table entries
+
+
+def msm_window_digits(bits, window: int = MSM_WINDOW):
+    """(..., nbits) LSB-first scalar bits -> (..., W) int32 window digits,
+    W = ceil(nbits/window). nbits zero-pads up to a multiple of `window`
+    (a zero MSB digit gathers the bucket-0 identity — harmless, same
+    stance as g1_scalar_mul_batch's odd-width pad). Shape-only callers
+    (the eval_shape loop-count pin) read W off the result shape."""
+    nbits = bits.shape[-1]
+    rem = (-nbits) % window
+    if rem:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (rem,), dtype=bits.dtype)],
+            axis=-1)
+        nbits += rem
+    n_windows = nbits // window
+    weights = jnp.asarray([1 << i for i in range(window)], dtype=jnp.int32)
+    return jnp.sum(
+        bits.reshape(bits.shape[:-1] + (n_windows, window)).astype(jnp.int32)
+        * weights, axis=-1)
+
+
+def _g1_bucket_tables(pt, window: int):
+    """Per-item bucket-multiple tables: tab[k] = [k]·P_i for k < 2^window,
+    stacked on a leading bucket axis — (2^w, N, limbs) per coordinate.
+    Entry 0 is the Jacobian zero (absorbed by the complete g1_add); even
+    entries double tab[k/2], odd entries add P once — 2^(w-1)-1 batched
+    doubles + 2^(w-1)-1 batched adds total."""
+    X, Y, Z = pt
+    table = [(jnp.zeros_like(X), jnp.zeros_like(Y), jnp.zeros_like(Z)), pt]
+    for k in range(2, 1 << window):
+        table.append(g1_double(table[k // 2]) if k % 2 == 0
+                     else g1_add(table[k - 1], pt))
+    return tuple(jnp.stack([t[i] for t in table]) for i in range(3))
+
+
+def g1_msm_pippenger(pt, bits, window: int = MSM_WINDOW):
+    """Σ_i [s_i]·P_i — windowed bucket MSM, one Jacobian point out.
+
+    `pt`: (N, limbs) Jacobian coordinate triple (Z = 0 entries contribute
+    the identity, so infinity pads and zero scalars are both safe);
+    `bits`: (N, nbits) bool, LSB first; `window` static.
+
+    Stages (all shape-stable under jit):
+      1. digits (N, W) via msm_window_digits;
+      2. per-item bucket tables (2^w, N, limbs) via _g1_bucket_tables;
+      3. bucket-multiple gather: take_along_axis picks [d_ij]·P_i per
+         (item, window) — the scatter-free dual of bucket accumulation;
+      4. window sums: ONE masked tree reduce over the item axis with W
+         lanes (the g1_segment_sum tree, mask folded into the digit-0
+         identity rows);
+      5. Horner combine, MSB window first: w doublings + one gathered add
+         per fori_loop step (W-1 steps — strictly fewer than the 2-bit
+         ladder's ceil(b/2)-1; bounds pinned int32 per the PR-1 s64/s32
+         dtype rule)."""
+    digits = msm_window_digits(bits, window)            # (N, W)
+    n_windows = digits.shape[-1]
+    tab = _g1_bucket_tables(pt, window)                 # (2^w, N, L)
+    gathered = tuple(
+        jnp.take_along_axis(jnp.moveaxis(c, 0, 1), digits[..., None], axis=1)
+        for c in tab)                                   # (N, W, L)
+    Sx, Sy, Sz = g1_sum_reduce(gathered)                # (W, L)
+
+    def body(i, acc):
+        w = n_windows - 2 - i
+        for _ in range(window):
+            acc = g1_double(acc)
+        nxt = (jnp.take(Sx, w, axis=0), jnp.take(Sy, w, axis=0),
+               jnp.take(Sz, w, axis=0))
+        return g1_add(acc, nxt)
+
+    acc = (Sx[n_windows - 1], Sy[n_windows - 1], Sz[n_windows - 1])
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_windows - 1), body, acc)
+
+
+@partial(jax.jit, static_argnames=("window",))
+def _g1_msm_program(X, Y, Z, bits, window: int = MSM_WINDOW):
+    """Jitted MSM entry: one XLA program per (n-bucket, nbits, window) —
+    callers pad the item count to a pow2 bucket so the jit cache stays
+    bounded (CompileTracker-pinned in tests/test_msm.py)."""
+    return g1_msm_pippenger((X, Y, Z), bits, window)
+
+
+@jax.jit
+def _g1_aggregate_program(X, Y, Z):
+    """All-ones-scalar MSM degenerate: Σ_i P_i via the bucketed tree sum
+    (no digits, no tables — every item lands in bucket 1 of a single
+    window). The committee-pubkey fast path."""
+    return g1_sum_reduce((X, Y, Z))
+
+
+@jax.jit
+def _g1_subgroup_program(X, Y, Z, bits):
+    """[r]·P_i == inf per item (r broadcast as fixed 255-bit scalar bits):
+    batched r-subgroup membership through the shared windowed ladder, so
+    cold pubkey validation leaves the host along with the aggregation."""
+    return F.fp_is_zero(g1_scalar_mul_batch((X, Y, Z), bits)[2])
+
+
+@lru_cache(maxsize=1)
+def _r_order_bits():
+    # NUMPY, not jnp: cached module constant, same trace-leak stance as
+    # _neg_g1_window_tables
+    return np.array([(R_ORDER >> i) & 1 for i in range(255)], dtype=bool)
+
+
+def _msm_pow2_pad(n: int, min_bucket: int = 8) -> int:
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+def _scalar_bits_lsb(scalars, nbits: int) -> np.ndarray:
+    out = np.zeros((len(scalars), nbits), dtype=bool)
+    for i, s in enumerate(scalars):
+        for b in range(nbits):
+            out[i, b] = (s >> b) & 1
+    return out
+
+
+def g1_msm_device(points_aff, scalars, nbits: int,
+                  window: int = MSM_WINDOW):
+    """Host-callable MSM: affine int pairs + int scalars in, affine int
+    pair out (None for the identity). Pads the item count to a pow2
+    bucket with (G1 generator, scalar 0) so the jit cache holds one
+    program per (bucket, nbits, window), then runs _g1_msm_program; the
+    affine unprojection is one host modular inverse on the single
+    reduced point."""
+    b = _msm_pow2_pad(len(points_aff))
+    pad = b - len(points_aff)
+    points_aff = list(points_aff) + [oracle.G1_GEN_AFF] * pad
+    scalars = list(scalars) + [0] * pad
+    enc = F.ints_to_mont_batch
+    X = jnp.asarray(enc([p[0] for p in points_aff]))
+    Y = jnp.asarray(enc([p[1] for p in points_aff]))
+    Z = jnp.broadcast_to(jnp.asarray(F.ONE_MONT), X.shape).astype(X.dtype)
+    bits = jnp.asarray(_scalar_bits_lsb(scalars, nbits))
+    sx, sy, sz = jax.device_get(_g1_msm_program(X, Y, Z, bits, window))  # tpulint: disable=recompile-risk -- nbits is a caller config constant (64 RLC / 255 full-width), not data-dependent; the item axis is pow2-bucketed above
+    unmont = lambda v: F.from_mont_int(np.asarray(v).reshape(-1, F.NLIMBS)[0])
+    xj, yj, zj = unmont(sx), unmont(sy), unmont(sz)
+    if zj == 0:
+        return None
+    zinv = pow(zj, P - 2, P)
+    return (xj * zinv * zinv % P, yj * zinv * zinv * zinv % P)
+
+
+def g1_aggregate_device(points_aff):
+    """Σ_i P_i (all-ones MSM fast path): affine int pairs in, affine pair
+    out (None for an infinity sum). Pads to the pow2 bucket with Jacobian
+    zeros — the complete add absorbs them, so padding never perturbs the
+    sum."""
+    b = _msm_pow2_pad(len(points_aff))
+    pad = b - len(points_aff)
+    enc = F.ints_to_mont_batch
+    X = jnp.asarray(enc([p[0] for p in points_aff] + [0] * pad))
+    Y = jnp.asarray(enc([p[1] for p in points_aff] + [0] * pad))
+    ones = np.zeros(b, dtype=bool)
+    ones[: len(points_aff)] = True
+    Z = jnp.where(jnp.asarray(ones)[:, None],
+                  jnp.broadcast_to(jnp.asarray(F.ONE_MONT), X.shape),
+                  jnp.zeros_like(X)).astype(X.dtype)
+    sx, sy, sz = jax.device_get(_g1_aggregate_program(X, Y, Z))
+    unmont = lambda v: F.from_mont_int(np.asarray(v).reshape(-1, F.NLIMBS)[0])
+    xj, yj, zj = unmont(sx), unmont(sy), unmont(sz)
+    if zj == 0:
+        return None
+    zinv = pow(zj, P - 2, P)
+    return (xj * zinv * zinv % P, yj * zinv * zinv * zinv % P)
+
+
+def g1_subgroup_check_device(points_aff) -> np.ndarray:
+    """r-subgroup membership per affine point, batched: (n,) bool. The
+    255-bit fixed scalar r is broadcast across the bucket-padded batch
+    (pads are Jacobian zeros — [r]·inf == inf reports True and is
+    discarded)."""
+    n = len(points_aff)
+    b = _msm_pow2_pad(n)
+    pad = b - n
+    enc = F.ints_to_mont_batch
+    X = jnp.asarray(enc([p[0] for p in points_aff] + [0] * pad))
+    Y = jnp.asarray(enc([p[1] for p in points_aff] + [0] * pad))
+    live = np.zeros(b, dtype=bool)
+    live[:n] = True
+    Z = jnp.where(jnp.asarray(live)[:, None],
+                  jnp.broadcast_to(jnp.asarray(F.ONE_MONT), X.shape),
+                  jnp.zeros_like(X)).astype(X.dtype)
+    bits = jnp.broadcast_to(jnp.asarray(_r_order_bits())[None, :], (b, 255))
+    ok = jax.device_get(_g1_subgroup_program(X, Y, Z, bits))
+    return np.asarray(ok)[:n]
+
+
+# Shape-only cost accounting for the eval_shape pins (tests/test_msm.py),
+# the BASELINE.md stage table, and benches/msm_bench.py — derived purely
+# from (n, nbits, window), never from compiled programs, so the claims are
+# assertable without tracing (same stance as rlc_miller_loop_count).
+
+
+def g1_ladder_loop_count(bits) -> int:
+    """Sequential fori_loop trip count of the 2-bit per-item ladder
+    (g1_scalar_mul_batch) for a (..., nbits) bits operand — works on
+    jax.eval_shape results."""
+    nbits = bits.shape[-1]
+    return (nbits + 1) // 2 - 1
+
+
+def msm_loop_count(digits) -> int:
+    """Sequential fori_loop trip count of the Pippenger Horner combine for
+    a (..., W) digits operand (msm_window_digits output) — works on
+    jax.eval_shape results."""
+    return digits.shape[-1] - 1
+
+
+def g1_ladder_op_counts(n: int, nbits: int) -> dict:
+    """Batched G1 point ops (one per lane) the per-item ladder pays for an
+    (n, nbits) MSM: per item, a 4-entry table (1 double + 1 add) then
+    ceil(nbits/2)-1 window steps of 2 doubles + 1 gathered add."""
+    nw = (nbits + 1) // 2
+    return {"doubles": n * (1 + 2 * (nw - 1)), "adds": n * nw}
+
+
+def g1_msm_op_counts(n: int, nbits: int, window: int = MSM_WINDOW) -> dict:
+    """Batched G1 point ops the Pippenger path pays for an (n, nbits, w)
+    MSM: bucket tables + masked window tree + Horner combine."""
+    n_windows = -(-nbits // window)
+    half = (1 << (window - 1)) - 1
+    return {
+        "doubles": n * half + window * (n_windows - 1),
+        "adds": n * half + (n - 1) * n_windows + (n_windows - 1),
+    }
+
+
+def g1_ladder_point_ops(n: int, nbits: int) -> int:
+    c = g1_ladder_op_counts(n, nbits)
+    return c["doubles"] + c["adds"]
+
+
+def g1_msm_point_ops(n: int, nbits: int, window: int = MSM_WINDOW) -> int:
+    c = g1_msm_op_counts(n, nbits, window)
+    return c["doubles"] + c["adds"]

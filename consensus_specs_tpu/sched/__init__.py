@@ -1,5 +1,6 @@
 """Unified verification scheduler: one shape-bucketed device queue for
-BLS pairing checks, KZG blob/proof batches, and Merkle root folds.
+BLS pairing checks, KZG blob/proof batches, Merkle root folds, and G1
+Pippenger multi-scalar multiplications.
 
 Public surface:
   * `Request` / `Handle` — the typed submit/future API (api.py)
@@ -18,12 +19,14 @@ from .classes import (  # noqa: F401
     BlsWorkClass,
     KzgWorkClass,
     MerkleWorkClass,
+    MsmWorkClass,
     WorkClass,
     default_classes,
 )
 from .scheduler import (  # noqa: F401
     DISPATCH_RETRY_POLICY,
     SchedResultIntegrityError,
+    SchedSelfCheckError,
     Scheduler,
     default_scheduler,
     reset_default_scheduler,

@@ -20,6 +20,8 @@ scheduler without dragging the device stack in.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from . import bucketing
@@ -70,6 +72,13 @@ class WorkClass:
     # merge_group(merged, requests) folds a whole same-key group in one
     # aggregation pass. None = the scheduler chains pairwise merge() calls.
     merge_group = None
+
+    # Optional post-dispatch value check: verify_results(requests, results)
+    # runs after the scheduler's shape/dtype validation and raises a
+    # retryable IntegrityError when a structurally valid batch fails a
+    # semantic self-check (the msm class's 2G2T outsourcing equation).
+    # None = no check.
+    verify_results = None
 
 
 class BlsWorkClass(WorkClass):
@@ -291,5 +300,150 @@ class MerkleWorkClass(WorkClass):
         return live, padded
 
 
+class MsmWorkClass(WorkClass):
+    """G1 multi-scalar multiplication lanes over the Pippenger kernel
+    (ops/bls12_jax.g1_msm_pippenger). Two kinds:
+
+    - "msm": payload = (points, scalars, nbits), points affine int pairs;
+      one Σ scalar_i·P_i per request via g1_msm_device.
+    - "aggregate": payload = tuple of compressed pubkey bytes — the
+      all-ones-scalar degenerate case, routed through crypto/bls_jax's
+      batched device subgroup check + g1_aggregate_device reduction tree
+      (the firehose cold-lane path).
+
+    Result rows are marker tuples in an object-dtype array — ("point", x,
+    y) | ("inf",) | ("inf_member",) | ("bad_encoding", msg) — so hostile
+    inputs travel as data instead of exceptions across the dispatch seam.
+    Every marker is truthy, which keeps the scheduler's failing-collapse
+    re-verify inert (this class never collapses). The bucketer bounds
+    compile diversity exactly as for the other lanes: one XLA program per
+    (pow2 item bucket, nbits, window).
+
+    With `self_check=True` (or env CONSENSUS_TPU_MSM_SELF_CHECK=1) each
+    "msm" row is verified post-dispatch with the 2G2T-style constant-size
+    outsourcing equation — see `verify_results` below.
+    """
+
+    name = "msm"
+    kinds = ("msm", "aggregate")
+    min_bucket = 8
+
+    def __init__(self, self_check: bool | None = None):
+        if self_check is None:
+            self_check = os.environ.get(
+                "CONSENSUS_TPU_MSM_SELF_CHECK", "") not in ("", "0")
+        self.self_check = bool(self_check)
+
+    def execute(self, requests: list) -> np.ndarray:
+        from ..crypto import bls_jax
+        from ..ops import bls12_jax as K
+
+        out = np.empty(len(requests), dtype=object)
+        for i, r in enumerate(requests):
+            if r.kind == "aggregate":
+                out[i] = bls_jax._aggregate_pubkeys_device_impl(
+                    list(r.payload))
+            else:
+                points, scalars, nbits = r.payload
+                total = K.g1_msm_device(
+                    list(points), list(scalars), int(nbits))
+                out[i] = (("inf",) if total is None
+                          else ("point", total[0], total[1]))
+        return out
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        from ..crypto import kzg_batch
+
+        out = np.empty(len(requests), dtype=object)
+        for i, r in enumerate(requests):
+            if r.kind == "aggregate":
+                out[i] = self._host_aggregate(list(r.payload))
+            else:
+                points, scalars, _nbits = r.payload
+                total = kzg_batch._host_msm(list(points), list(scalars))
+                out[i] = (("inf",) if total is None
+                          else ("point", total[0], total[1]))
+        return out
+
+    @staticmethod
+    def _host_aggregate(pubkeys_bytes: list):
+        """Host-oracle twin of bls_jax._aggregate_pubkeys_device_impl:
+        same marker protocol, validated g1_from_bytes + pt_add loop."""
+        from ..crypto import bls12_381 as oracle
+
+        acc = None
+        try:
+            for pk in pubkeys_bytes:
+                aff = oracle.g1_from_bytes(bytes(pk))
+                if aff is None:
+                    return ("inf_member",)
+                pt = oracle.pt_from_affine(oracle.FP_FIELD, aff)
+                acc = (pt if acc is None
+                       else oracle.pt_add(oracle.FP_FIELD, acc, pt))
+        except ValueError as e:
+            return ("bad_encoding", str(e))
+        aff = oracle.pt_to_affine(oracle.FP_FIELD, acc)
+        return ("inf",) if aff is None else ("point", aff[0], aff[1])
+
+    def to_result(self, row):
+        return row
+
+    def load(self, requests: list) -> tuple:
+        # units are MSM terms: each request pads its own item count to the
+        # pow2 bucket inside g1_msm_device / g1_aggregate_device
+        live = padded = 0
+        for r in requests:
+            n = (len(r.payload) if r.kind == "aggregate"
+                 else len(r.payload[0]))
+            live += n
+            padded += bucketing.pow2_bucket(max(1, n), self.min_bucket)
+        return live, padded
+
+    def verify_results(self, requests: list, results) -> None:
+        """2G2T-style outsourcing check on "msm" rows: draw a random
+        64-bit c and require host [c]·R_claimed == device MSM over the
+        rerandomized scalars c·s_i mod r — two independent evaluations of
+        the same sum bound by a random scalar, so a corrupt-but-well-formed
+        row is caught BEFORE any handle resolves (the failure mode the
+        scheduler's shape/dtype validation cannot see). This catches
+        faults, not an adversarial kernel: a deterministic corruption of
+        both evaluations could still agree. "aggregate" rows skip the
+        check — a wrong committee aggregate fails the downstream pairing
+        check, which already re-attributes per member."""
+        if not self.self_check:
+            return
+        import secrets
+
+        from ..crypto import bls12_381 as oracle
+        from ..ops import bls12_jax as K
+
+        for r, row in zip(requests, results):
+            if r.kind != "msm":
+                continue
+            tag = row[0]
+            if tag == "point":
+                claimed = (int(row[1]), int(row[2]))
+            elif tag == "inf":
+                claimed = None
+            else:
+                continue
+            points, scalars, _nbits = r.payload
+            c = secrets.randbelow(2**64 - 1) + 1
+            expect = (None if claimed is None else oracle.pt_to_affine(
+                oracle.FP_FIELD,
+                oracle.pt_mul(
+                    oracle.FP_FIELD,
+                    oracle.pt_from_affine(oracle.FP_FIELD, claimed), c)))
+            redo = K.g1_msm_device(
+                list(points), [c * s % oracle.R for s in scalars], 255)
+            if redo != expect:
+                from .scheduler import SchedSelfCheckError
+
+                raise SchedSelfCheckError(
+                    f"sched.dispatch[{self.name}]: 2G2T self-check "
+                    f"mismatch on a {len(scalars)}-term MSM")
+
+
 def default_classes() -> list:
-    return [BlsWorkClass(), KzgWorkClass(), MerkleWorkClass()]
+    return [BlsWorkClass(), KzgWorkClass(), MerkleWorkClass(),
+            MsmWorkClass()]

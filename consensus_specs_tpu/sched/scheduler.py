@@ -62,6 +62,14 @@ class SchedResultIntegrityError(_faults.IntegrityError):
     host-side and intact, so re-execution is safe."""
 
 
+class SchedSelfCheckError(_faults.IntegrityError):
+    """A work class's post-dispatch `verify_results` hook rejected a
+    structurally VALID result batch — the seam where the msm class's
+    2G2T-style outsourcing equation catches well-formed-but-wrong values
+    that shape/dtype validation cannot. Retryable for the same reason as
+    SchedResultIntegrityError."""
+
+
 class _Entry:
     """One queue slot: the requests collapsed into it and their handles."""
 
@@ -312,7 +320,15 @@ class Scheduler:
                 _faults.fire("sched.dispatch")
                 res = np.asarray(wc.execute(requests))
                 res = _faults.corrupt_array("sched.dispatch", res)
-                return self._validated(res, n, wc.name)
+                res = self._validated(res, n, wc.name)
+                # Optional per-class value check (msm's 2G2T equation):
+                # raises a retryable error so corrupt-but-well-formed rows
+                # re-execute or degrade instead of resolving handles. The
+                # degraded path below skips it — the host oracle is the
+                # trust anchor the check compares against.
+                if wc.verify_results is not None:
+                    wc.verify_results(requests, res)
+                return res
 
             degraded = False
             try:

@@ -18,3 +18,12 @@ def widen(n):
 
 def window(n):
     return jnp.arange(n)  # tpulint-expect: dtype-pin
+
+
+def horner_combine(acc, n_windows):
+    """The MSM Horner-combine shape (PR 11) with the bad spelling: a
+    runtime-derived upper bound left unpinned traces s64 under x64."""
+    def body(i, a):
+        return a + jnp.int32(i)
+
+    return jax.lax.fori_loop(jnp.int32(0), n_windows - 1, body, acc)  # tpulint-expect: dtype-pin

@@ -41,6 +41,7 @@ BENCH_QUICK_ENV = {
     "BENCH_ATT_VALIDATORS": "32768",
     "BENCH_SR_VALIDATORS": "262144",
     "BENCH_E2E_VALIDATORS": "1048576",
+    "BENCH_MSM_N": "64",
 }
 
 
@@ -94,6 +95,9 @@ def check_e2e_lane() -> int:
     if rc:
         return rc
     rc = check_scenario_lane(extra)
+    if rc:
+        return rc
+    rc = check_msm_lane(extra)
     if rc:
         return rc
     return check_obs_snapshot()
@@ -167,6 +171,29 @@ def check_scenario_lane(extra: dict) -> int:
           f"(slots/s={extra['scenario_slots_per_s']}, "
           f"reorg_depth={extra['scenario_reorg_depth_max']}, "
           f"vectors={extra['scenario_vectors_emitted']})", file=sys.stderr)
+    return 0
+
+
+def check_msm_lane(extra: dict) -> int:
+    """Refuse a record without the Pippenger MSM lane: the items/s number
+    is the kernel headline for every Σ scalar_i·P_i consumer (KZG folds,
+    committee aggregation), and the vs-ladder speedup is the evidence that
+    the bucket decomposition actually beats the per-item ladder it
+    replaced on the SAME inputs — a bench that dropped the lane would keep
+    reporting kzg_blobs_per_s with no kernel-level attribution."""
+    missing = [k for k in ("msm_items_per_s", "msm_vs_ladder_speedup",
+                           "msm_n", "msm_window")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"Pippenger MSM lane (missing {missing}); fix "
+              f"benches/msm_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: msm lane present "
+          f"(items/s={extra['msm_items_per_s']}, "
+          f"speedup={extra['msm_vs_ladder_speedup']}x at "
+          f"n={extra['msm_n']} w={extra['msm_window']})", file=sys.stderr)
     return 0
 
 
