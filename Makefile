@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched msm firehose scenarios lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched msm firehose scenarios slo lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -46,6 +46,7 @@ testfast:
 chaos:
 	mkdir -p test-results
 	OBS_SNAPSHOT=test-results/obs_chaos.json OBS_SNAPSHOT_LANE=chaos \
+	OBS_FLIGHT_DIR=test-results \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_chaos_epoch.py tests/test_robustness.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_chaos.json
@@ -58,6 +59,7 @@ chaos:
 sched:
 	mkdir -p test-results
 	OBS_SNAPSHOT=test-results/obs_sched.json OBS_SNAPSHOT_LANE=sched \
+	OBS_FLIGHT_DIR=test-results \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_sched.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_sched.json
@@ -72,6 +74,7 @@ sched:
 msm:
 	mkdir -p test-results
 	OBS_SNAPSHOT=test-results/obs_msm.json OBS_SNAPSHOT_LANE=msm \
+	OBS_FLIGHT_DIR=test-results \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_msm.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_msm.json
@@ -84,6 +87,7 @@ msm:
 firehose:
 	mkdir -p test-results
 	OBS_SNAPSHOT=test-results/obs_firehose.json OBS_SNAPSHOT_LANE=firehose \
+	OBS_FLIGHT_DIR=test-results \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_firehose.py tests/test_gossip_driver.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_firehose.json
@@ -98,9 +102,21 @@ firehose:
 scenarios:
 	mkdir -p test-results
 	OBS_SNAPSHOT=test-results/obs_scenarios.json OBS_SNAPSHOT_LANE=scenarios \
+	OBS_FLIGHT_DIR=test-results \
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_scenarios.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_scenarios.json
+
+# Declarative SLO gate (slo.json at the repo root): the bench trajectory
+# and obs-snapshot invariants as machine-checked objectives — see README
+# "Observability" and the SLO table in BASELINE.md. Evaluates the shipped
+# BENCH_OBS.json plus whatever lane snapshots the sibling targets left in
+# test-results/, against BENCH_LOCAL.json history; rc != 0 names the
+# violated SLO. bench.py embeds the same verdict in every record it
+# persists; this target is the standalone/CI entry point.
+slo:
+	$(PYTHON) tools/slo_check.py --bench BENCH_LOCAL.json \
+	    BENCH_OBS.json $(wildcard test-results/obs_*.json)
 
 # Compile-check every module and spec document (the exec-based analog of the
 # reference's `make pyspec` build of eth2spec modules). With ARTIFACTS=1 the

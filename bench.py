@@ -453,10 +453,44 @@ def main() -> None:
             "error": f"{type(exc).__name__}: {exc}"[:500],
         }
     if "value" in record and record["value"] > 0:
+        _gate_slos(record)
         # real measurements only (incl. labeled cpu-debug): crash records
         # with value 0 carry no perf evidence worth committing
         persist_local(record)
     print(json.dumps(record))
+
+
+def _gate_slos(record: dict) -> None:
+    """Evaluate slo.json against this run BEFORE persisting, so the record
+    carries its own verdict (extra["slo"]) and a regression is visible in
+    the history, not just in CI. Non-fatal by design: the scoreboard line
+    must print no matter what, and `make slo` / tools/slo_check.py is the
+    enforcing gate (rc != 0)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    spec_path = os.path.join(root, "slo.json")
+    try:
+        from consensus_specs_tpu.obs import export as obs_export
+        from consensus_specs_tpu.obs import slo as obs_slo
+
+        specs = obs_slo.load_spec_file(spec_path)
+        snap = obs_export.snapshot_dict(meta={"lane": "bench"})
+        history = []
+        local = os.path.join(root, "BENCH_LOCAL.json")
+        if os.path.exists(local):
+            with open(local) as f:
+                history = json.load(f)
+            if not isinstance(history, list):
+                history = [history]
+        # run_benches() uninstalled its tracer, so disabled-mode overhead
+        # is measurable in-process here
+        results = obs_slo.evaluate(specs, [snap], history + [record])
+        record.setdefault("extra", {})["slo"] = obs_slo.summarize(results)
+        for r in results:
+            if not r.ok:
+                print(f"# SLO VIOLATION {r.name}: {r.detail}",
+                      file=sys.stderr)
+    except Exception as exc:
+        print(f"# slo evaluation failed: {exc}", file=sys.stderr)
 
 
 if __name__ == "__main__":

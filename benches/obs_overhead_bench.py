@@ -5,10 +5,18 @@ lookups per span — production seams instrument unconditionally, so the
 disabled path IS the hot path. This bench pins that cost in nanoseconds:
 
   * disabled `span()` enter/exit (the seam pattern), bare and with attrs;
+  * disabled `span()` with the causal-propagation kwargs (ctx=None,
+    links=None) compiled in — the shape every firehose/sched seam now has
+    after ISSUE 13; the trace-context mint itself is gated on an installed
+    tracer, so None-kwargs IS the full disabled cost of causality;
   * disabled `annotate()` (the fault/retry deep-seam pattern);
   * a registry counter inc via cached handle and via registry lookup
     (both always-on: faults/retry/breaker tick them regardless of tracing);
-  * enabled `span()` enter/exit for contrast (ring append + histogram).
+  * a flight-recorder `record()` (always-on black box: faults, breaker
+    transitions, queue samples land in the bounded ring unconditionally);
+  * enabled `span()` enter/exit for contrast (ring append + histogram),
+    and enabled with a minted TraceContext + one link for the full
+    causal-tracing cost.
 
 The macro claim — < 2% on benches/epoch_e2e_bench.py with tracing disabled
 versus the pre-instrumentation tree — is a committed before/after
@@ -24,6 +32,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from consensus_specs_tpu.obs import context as obs_context  # noqa: E402
+from consensus_specs_tpu.obs import flight as obs_flight  # noqa: E402
 from consensus_specs_tpu.obs import metrics as obs_metrics  # noqa: E402
 from consensus_specs_tpu.obs import trace as obs_trace  # noqa: E402
 
@@ -32,7 +42,8 @@ REPEAT = 5
 
 
 def ns_per_op(stmt, setup="pass", number=NUMBER):
-    glb = {"trace": obs_trace, "metrics": obs_metrics}
+    glb = {"trace": obs_trace, "metrics": obs_metrics,
+           "context": obs_context, "flight": obs_flight}
     best = min(timeit.repeat(stmt, setup=setup, repeat=REPEAT, number=number,
                              globals=glb))
     return best / number * 1e9
@@ -47,8 +58,15 @@ def run() -> dict:
         "\nwith trace.span('engine.dispatch'):\n    pass"), 1)
     out["disabled_span_attrs_ns"] = round(ns_per_op(
         "\nwith trace.span('engine.dispatch', epoch=3, k=9):\n    pass"), 1)
+    out["disabled_span_ctx_ns"] = round(ns_per_op(
+        "\nwith trace.span('firehose.ingest', ctx=None, links=None):\n"
+        "    pass"), 1)
     out["disabled_annotate_ns"] = round(ns_per_op(
         "trace.annotate(fault_sites='engine.dispatch')"), 1)
+    out["flight_record_ns"] = round(ns_per_op(
+        "rec.record('queue', trigger='interval', pending=7)",
+        setup="rec = flight.FlightRecorder("
+              "registry=metrics.MetricsRegistry())"), 1)
     out["counter_inc_cached_ns"] = round(ns_per_op(
         "c.inc()",
         setup="c = metrics.MetricsRegistry().counter('x', site='s')"), 1)
@@ -61,6 +79,11 @@ def run() -> dict:
     try:
         out["enabled_span_ns"] = round(ns_per_op(
             "\nwith trace.span('engine.dispatch'):\n    pass",
+            number=NUMBER // 10), 1)
+        out["enabled_span_causal_ns"] = round(ns_per_op(
+            "\nwith trace.span('firehose.ingest', ctx=context.mint_trace(),"
+            " links=[link]):\n    pass",
+            setup="link = context.mint_trace()",
             number=NUMBER // 10), 1)
     finally:
         tracer.uninstall()

@@ -15,7 +15,9 @@ the caller and are only touched inside the classifier closure.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
+from ..obs.context import TraceContext
 from ..parallel.gossip_driver import message_id
 
 
@@ -35,6 +37,10 @@ class AttestationItem:
     message: bytes    # signing root every participant signed
     signature: bytes  # aggregate signature over `message`
     ssz: bytes        # raw payload; retry/restore re-enter from host bytes
+    # Causal identity, minted by the pipeline at ingest when a tracer is
+    # installed (None otherwise — classifiers never mint). Rides the item
+    # into the sched Request so the dispatch span can link back to it.
+    trace: Optional[TraceContext] = None
 
 
 def beacon_classifier(spec, state):

@@ -51,8 +51,10 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
+from ..obs import context as _obs_context
+from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs_trace
 from ..robustness import faults as _faults
@@ -190,7 +192,12 @@ class AttestationFirehose:
 
     def _ingest_one(self, raw: bytes):
         reg = self.registry
-        with _obs_trace.span("firehose.ingest"):
+        # Mint the request's causal identity here — ingest IS the birth of
+        # a request — but only under an installed tracer, preserving the
+        # disabled-mode overhead contract (nothing mints, nothing links).
+        ctx = (_obs_context.mint_trace()
+               if _obs_trace.current_tracer() is not None else None)
+        with _obs_trace.span("firehose.ingest", ctx=ctx):
 
             def attempt():
                 _faults.fire("firehose.ingest")
@@ -210,7 +217,7 @@ class AttestationFirehose:
                     self._seen.pop(next(iter(self._seen)))
                     reg.counter("firehose_dedup_evictions_total").inc()
             reg.counter("firehose_ingested_total").inc()
-            return item
+            return item if ctx is None else replace(item, trace=ctx)
 
     # -- arrival-rate tracking ---------------------------------------------
 
@@ -265,7 +272,12 @@ class AttestationFirehose:
         cfg = self.config
         reg = self.registry
         admitted = 0
-        with _obs_trace.span("firehose.aggregate", batch=len(items)):
+        # fan-in: the aggregate span links every admitted item's context,
+        # so the admission pass a request went through is discoverable
+        links = ([it.trace for it in items if it.trace is not None]
+                 if _obs_trace.current_tracer() is not None else None)
+        with _obs_trace.span("firehose.aggregate", batch=len(items),
+                             links=links or None):
             while items:
                 with self._lock:
                     room = cfg.max_pending - self._pending
@@ -299,7 +311,7 @@ class AttestationFirehose:
                         Request(work_class="bls", kind="fast_aggregate",
                                 payload=(list(it.pubkeys), it.message,
                                          it.signature),
-                                group_key=it.key)
+                                group_key=it.key, trace=it.trace)
                         for it in chunk])
 
                 try:
@@ -363,11 +375,19 @@ class AttestationFirehose:
                     self._failure = exc
                     self._room.notify_all()
                 self.registry.counter("firehose_kills_total").inc()
+                # black box: the worker is about to die mid-stream —
+                # freeze the event ring before the evidence scrolls away
+                _flight.record("firehose_kill", error=type(exc).__name__,
+                               detail=str(exc)[:200])
+                _flight.dump("firehose_killed",
+                             meta={"error": type(exc).__name__})
                 return
 
     def _flush_once(self, trigger: str) -> None:
         reg = self.registry
         entries, members = self.scheduler.queue_load("bls")
+        _flight.record("queue", trigger=trigger, committees=entries,
+                       attestations=members, pending=self._pending)
         with _obs_trace.span("firehose.flush", trigger=trigger,
                              committees=entries, attestations=members):
             if entries:
@@ -412,11 +432,24 @@ class AttestationFirehose:
             for msg_id, _key, handle, t_ingest in done:
                 ok = bool(handle.result())
                 self._results[msg_id] = ok
-                lat.observe(max(0.0, now - t_ingest))
+                tr = handle.request.trace
+                lat.observe(max(0.0, now - t_ingest),
+                            exemplar=(tr.trace_id if tr is not None
+                                      else None))
                 verified += ok
                 rejected += not ok
             reg.gauge("firehose_queue_depth").set(self._pending)
             self._room.notify_all()
+        if done and _obs_trace.current_tracer() is not None:
+            # resolve marker: links every request whose verdict landed in
+            # this collect pass, closing the ingest→...→resolve chain the
+            # timeline exporter follows
+            rlinks = [rec[2].request.trace for rec in done
+                      if rec[2].request.trace is not None]
+            with _obs_trace.span("firehose.resolve", resolved=len(done),
+                                 verified=verified, rejected=rejected,
+                                 links=rlinks or None):
+                pass
         if verified:
             reg.counter("firehose_verified_total").inc(verified)
         if rejected:

@@ -1,19 +1,32 @@
 """Unified observability for the resident pipeline.
 
-Three cooperating pieces, all jax-free at module level (device hooks are
+Seven cooperating pieces, all jax-free at module level (device hooks are
 deferred behind install calls — the tpulint import-layering rule enforces
 this):
 
   obs.metrics    process-wide registry: counters, gauges, fixed-bucket
-                 histograms with p50/p99 readout (`REGISTRY`).
+                 histograms with p50/p99 readout + per-bucket trace-id
+                 exemplars (`REGISTRY`).
   obs.trace      span tracer (`span("engine.dispatch")`), disabled unless a
-                 Tracer is installed — the FaultPlan pattern.
+                 Tracer is installed — the FaultPlan pattern. Spans carry
+                 TraceContexts and fan-in/fan-out span links.
+  obs.context    TraceContext minting/propagation: one trace id per
+                 ingested request, carried on AttestationItem and sched
+                 Request across threads.
+  obs.flight     always-on flight recorder: bounded structured-event ring
+                 dumped as a canonical-JSON black box on incident
+                 triggers (breaker open, FirehoseKilled, self-check,
+                 scenario divergence).
   obs.recompile  per-kernel compile counter via jax's lowering log +
                  jax.monitoring durations; no-op off-device.
   obs.export     canonical JSON snapshot + Prometheus text, one value set.
+  obs.timeline   Perfetto/Chrome-trace export: spans in per-thread lanes,
+                 flow events following a request across them.
+  obs.slo        declarative SLO gate over snapshots + BENCH_LOCAL.json
+                 (tools/slo_check.py is the CLI).
 
-See README "Observability" for the span map and BASELINE.md for what each
-metric watches.
+See README "Observability" for the four-layer map and BASELINE.md for
+what each metric/SLO watches.
 """
 from .metrics import REGISTRY, MetricsRegistry, DEFAULT_BUCKETS, series_key
 from .trace import (
@@ -23,6 +36,8 @@ from .trace import (
     current_tracer,
     span,
 )
+from .context import TraceContext, mint_trace
+from .flight import FlightRecorder, current_recorder
 from .recompile import BACKEND_COMPILE_EVENT, CompileTracker, current_tracker
 from .export import (
     canonical_json,
@@ -45,6 +60,10 @@ __all__ = [
     "annotate",
     "current_tracer",
     "span",
+    "TraceContext",
+    "mint_trace",
+    "FlightRecorder",
+    "current_recorder",
     "BACKEND_COMPILE_EVENT",
     "CompileTracker",
     "current_tracker",

@@ -1,0 +1,80 @@
+"""Trace-context propagation: the causal identity of one request.
+
+A `TraceContext` is the minimal W3C-traceparent analog this stack needs:
+a trace id naming one request's whole journey (minted once, at firehose /
+gossip ingest) plus the span id of the context's creation point, so a span
+opened WITH a context knows both which request it serves and which span
+caused it. Contexts are carried as plain fields on the host-side carriers
+that already cross thread boundaries — `AttestationItem`, sched
+`Request`/`Handle` — never through thread-locals, because the producer
+thread that mints a context is not the flusher thread that resolves it.
+
+Fan-in/fan-out is expressed with *span links* (obs/trace.py): a collapsed
+flush batch's `sched.dispatch` span links to every member's context (N
+requests → one device check), and a failed collapse's `sched.reverify`
+span links to the exact member set it re-verifies (one failure → N
+attributions). The timeline exporter (obs/timeline.py) follows a trace id
+through ctx-carrying spans AND links, which is what makes a verdict
+attributable to its full ingest→admit→seal→dispatch→resolve path.
+
+Id allocation is a process-wide counter, not a RNG: ids only need to be
+unique within one process lifetime (the artifact formats carry them as
+opaque strings), and a counter keeps minting cheap and replay-friendly.
+Minting is gated by the caller on an installed tracer — with tracing
+disabled nothing mints, so the PR-6 disabled-overhead contract holds.
+
+jax-free at module level by charter (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+_lock = threading.Lock()
+_ids = itertools.count(1)
+
+
+def _next_id(prefix: str) -> str:
+    with _lock:
+        return f"{prefix}{next(_ids):08x}"
+
+
+def reset_ids() -> None:
+    """Restart the id counter (test determinism only — production never
+    resets, uniqueness within the process is the contract)."""
+    global _ids
+    with _lock:
+        _ids = itertools.count(1)
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One request's causal identity: (trace id, span id of the minting /
+    forking point, optional parent span id)."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+
+    def child(self) -> "TraceContext":
+        """A new context in the SAME trace, parented on this one — the
+        shape a stage hands downstream when it starts sub-work."""
+        return TraceContext(self.trace_id, _next_id("s"), self.span_id)
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TraceContext":
+        return TraceContext(d["trace_id"], d["span_id"],
+                            d.get("parent_span_id"))
+
+
+def mint_trace() -> TraceContext:
+    """A fresh root context: new trace id, new span id, no parent. Callers
+    gate on `trace.current_tracer() is not None` so disabled mode never
+    pays the counter."""
+    return TraceContext(_next_id("t"), _next_id("s"), None)

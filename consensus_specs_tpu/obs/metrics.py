@@ -111,7 +111,7 @@ class Histogram:
     Prometheus text format so the two exporters share one value set)."""
 
     __slots__ = ("key", "buckets", "_counts", "_sum", "_count", "_min",
-                 "_max", "_lock")
+                 "_max", "_exemplars", "_lock")
 
     def __init__(self, key: str, lock: threading.Lock,
                  buckets: tuple = DEFAULT_BUCKETS):
@@ -123,9 +123,16 @@ class Histogram:
         self._count = 0
         self._min = None
         self._max = None
+        self._exemplars: dict[int, str] = {}  # bucket ix -> last trace id
         self._lock = lock
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        """Record one observation. `exemplar` (optional) is a trace id
+        retained per bucket — LAST writer wins — so a fat p99 bucket links
+        to a replayable trace. Exemplars surface in the JSON snapshot
+        only, never in the Prometheus text, and a histogram that never
+        receives one snapshots byte-identically to the pre-exemplar
+        format."""
         v = float(v)
         ix = bisect_left(self.buckets, v)
         with self._lock:
@@ -136,6 +143,8 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars[ix] = str(exemplar)
 
     @property
     def count(self) -> int:
@@ -181,12 +190,23 @@ class Histogram:
         out.append(("+Inf", self._count))
         return out
 
+    def exemplars(self) -> dict:
+        """{bucket le label: trace id} for buckets holding an exemplar
+        (the +Inf bucket labels as "+Inf"); empty when none recorded."""
+        out = {}
+        for ix, trace_id in self._exemplars.items():
+            le = ("+Inf" if ix >= len(self.buckets)
+                  else repr(float(self.buckets[ix])))
+            out[le] = trace_id
+        return out
+
     def _reset(self) -> None:
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
         self._min = None
         self._max = None
+        self._exemplars = {}
 
 
 class MetricsRegistry:
@@ -282,6 +302,12 @@ class MetricsRegistry:
                     "p50": h.quantile(0.50),
                     "p99": h.quantile(0.99),
                 }
+                # exemplars are JSON-snapshot-only (the Prometheus text and
+                # both exporter value sets never see them) and the key is
+                # OMITTED when none were recorded, so exemplar-free
+                # registries snapshot byte-identically to the v1 format
+                if h._exemplars:
+                    hists[k]["exemplars"] = h.exemplars()
         return {
             "version": 1,
             "counters": counters,

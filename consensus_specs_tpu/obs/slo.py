@@ -1,0 +1,269 @@
+"""Declarative SLO engine: the bench trajectory as a machine-checked gate.
+
+BASELINE.md records what the stack measured; this module makes the floor
+beneath those numbers executable. An SLO spec (slo.json at the repo root)
+is a list of declarative objects evaluated against two evidence sources:
+
+  source "obs"    a canonical obs snapshot (BENCH_OBS.json, the
+                  test-results/obs_<lane>.json artifacts): counter value,
+                  gauge value, a histogram stat (p50/p99/count/max), or
+                  the compile-per-shape reconciliation — for every
+                  `compile_total{kernel=K}` counter the matching
+                  `compile_distinct_shapes{kernel=K}` gauge must equal it
+                  (one XLA compile per (class, bucket), the PR-8 pin).
+  source "bench"  BENCH_LOCAL.json history: a dotted path into the MOST
+                  RECENT record that resolves it (records are
+                  heterogeneous — full bench runs carry sched extras,
+                  probe runs only firehose extras).
+  source "overhead"  measured in-process: ns per disabled-mode span()
+                  call with ctx/links propagation compiled in — the PR-6
+                  contract as a gate instead of prose.
+
+Each spec may scope itself to snapshot lanes (`"lanes": ["bench"]`): the
+zero-drops SLO must hold on a clean bench run but NOT on chaos-lane
+snapshots, where backpressure drops are injected deliberately. Missing
+evidence is per-spec policy (`"missing": "pass" | "fail"`): lane
+artifacts legitimately lack other lanes' series, while a bench metric
+that vanishes from history should fail loudly.
+
+tools/slo_check.py is the CLI (rc != 0 names the violated SLO);
+bench.py evaluates the same spec after every run and embeds the verdict
+in the persisted record.
+
+jax-free at module level by charter (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import json
+import timeit
+from dataclasses import dataclass, field
+from typing import Optional
+
+SPEC_VERSION = 1
+
+_OPS = {
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+}
+
+
+@dataclass(frozen=True)
+class SloSpec:
+    """One declarative objective. `kind` only applies to source "obs"."""
+
+    name: str
+    source: str                 # "obs" | "bench" | "overhead"
+    op: str                     # key into _OPS
+    value: float
+    kind: str = "counter"       # counter | gauge | histogram | compile_per_shape
+    series: Optional[str] = None
+    stat: str = "p99"           # histogram stat: p50 | p99 | count | max | sum
+    path: Optional[str] = None  # bench dotted path, e.g. "extra.sched_occupancy_min"
+    lanes: tuple = ()           # () = any snapshot; else meta.lane must match
+    missing: str = "fail"       # verdict when no evidence resolves
+    note: str = ""
+
+
+@dataclass
+class SloResult:
+    name: str
+    ok: bool
+    measured: Optional[float]
+    detail: str
+    spec: SloSpec = field(repr=False, default=None)
+
+
+def load_spec(obj: dict) -> list[SloSpec]:
+    if not isinstance(obj, dict) or obj.get("version") != SPEC_VERSION:
+        raise ValueError(
+            f"SLO spec version {obj.get('version')!r} != {SPEC_VERSION}")
+    specs = []
+    for raw in obj.get("slos", []):
+        d = dict(raw)
+        d["lanes"] = tuple(d.get("lanes", ()))
+        spec = SloSpec(**d)
+        if spec.op not in _OPS:
+            raise ValueError(f"SLO {spec.name!r}: unknown op {spec.op!r}")
+        if spec.source not in ("obs", "bench", "overhead"):
+            raise ValueError(
+                f"SLO {spec.name!r}: unknown source {spec.source!r}")
+        if spec.missing not in ("pass", "fail"):
+            raise ValueError(
+                f"SLO {spec.name!r}: missing policy {spec.missing!r}")
+        specs.append(spec)
+    return specs
+
+
+def load_spec_file(path) -> list[SloSpec]:
+    with open(path) as f:
+        return load_spec(json.load(f))
+
+
+# -- evidence extraction ------------------------------------------------------
+
+
+def _lane_of(snap: dict) -> str:
+    meta = snap.get("meta")
+    return meta.get("lane", "") if isinstance(meta, dict) else ""
+
+
+def _snaps_for(spec: SloSpec, snapshots: list) -> list:
+    if not spec.lanes:
+        return snapshots
+    return [s for s in snapshots if _lane_of(s) in spec.lanes]
+
+
+def _hist_stat(h: dict, stat: str) -> float:
+    if stat in ("p50", "p99", "count", "sum", "max", "min"):
+        v = h.get(stat)
+        return float(v) if v is not None else 0.0
+    raise ValueError(f"unknown histogram stat {stat!r}")
+
+
+def _obs_measurements(spec: SloSpec, snapshots: list) -> list:
+    """[(value, where)] across every in-scope snapshot holding evidence."""
+    out = []
+    for i, snap in enumerate(_snaps_for(spec, snapshots)):
+        where = _lane_of(snap) or f"snapshot[{i}]"
+        if spec.kind == "counter":
+            if spec.series in snap.get("counters", {}):
+                out.append((float(snap["counters"][spec.series]), where))
+        elif spec.kind == "gauge":
+            if spec.series in snap.get("gauges", {}):
+                out.append((float(snap["gauges"][spec.series]), where))
+        elif spec.kind == "histogram":
+            h = snap.get("histograms", {}).get(spec.series)
+            if h is not None:
+                out.append((_hist_stat(h, spec.stat), where))
+        elif spec.kind == "compile_per_shape":
+            # measured value: total EXCESS compiles beyond one per distinct
+            # shape, summed over every compile_total{kernel=...} series
+            counters = snap.get("counters", {})
+            gauges = snap.get("gauges", {})
+            kernels = [k for k in counters if k.startswith("compile_total{")]
+            if kernels:
+                excess = 0.0
+                for k in kernels:
+                    shapes_key = k.replace(
+                        "compile_total{", "compile_distinct_shapes{", 1)
+                    excess += float(counters[k]) - float(
+                        gauges.get(shapes_key, 0.0))
+                out.append((excess, where))
+        else:
+            raise ValueError(f"unknown obs kind {spec.kind!r}")
+    return out
+
+
+def _bench_measurement(spec: SloSpec, records: list):
+    """Latest record (scanning backwards) where the dotted path resolves
+    to a number; None when nothing in history carries it."""
+    parts = (spec.path or "").split(".")
+    for rec in reversed(records):
+        node = rec
+        for p in parts:
+            if isinstance(node, dict) and p in node:
+                node = node[p]
+            else:
+                node = None
+                break
+        if isinstance(node, (int, float)) and not isinstance(node, bool):
+            return float(node), rec.get("timestamp", "?")
+    return None
+
+
+def measure_disabled_span_ns(number: int = 20_000) -> float:
+    """ns per disabled-mode span() with ctx/links propagation compiled in
+    — the A side of the obs_overhead_bench A/B, sized to run in
+    milliseconds so the SLO gate can afford it inline."""
+    from . import trace as _trace
+
+    if _trace.current_tracer() is not None:
+        raise RuntimeError("a tracer is installed; disabled-mode overhead "
+                           "cannot be measured")
+    t = timeit.timeit(
+        "span('slo.probe', ctx=None, links=None)",
+        globals={"span": _trace.span}, number=number)
+    return t / number * 1e9
+
+
+# -- evaluation ---------------------------------------------------------------
+
+
+def evaluate(specs: list, snapshots: list, bench_records: list,
+             *, overhead_ns: Optional[float] = None) -> list:
+    """One SloResult per spec. `overhead_ns` may be pre-measured (bench.py
+    measures before installing its tracer); otherwise overhead specs
+    measure inline, or skip-pass when a tracer is installed."""
+    from . import trace as _trace
+
+    results = []
+    for spec in specs:
+        cmp_op = _OPS[spec.op]
+        if spec.source == "bench":
+            got = _bench_measurement(spec, bench_records)
+            if got is None:
+                ok = spec.missing == "pass"
+                results.append(SloResult(
+                    spec.name, ok, None,
+                    f"no bench record resolves {spec.path!r} "
+                    f"(missing={spec.missing})", spec))
+                continue
+            measured, where = got
+            ok = bool(cmp_op(measured, spec.value))
+            results.append(SloResult(
+                spec.name, ok, measured,
+                f"{spec.path}={measured:g} {spec.op} {spec.value:g} "
+                f"(record {where})", spec))
+        elif spec.source == "obs":
+            hits = _obs_measurements(spec, snapshots)
+            if not hits:
+                ok = spec.missing == "pass"
+                results.append(SloResult(
+                    spec.name, ok, None,
+                    f"no snapshot in lanes {list(spec.lanes) or 'any'} "
+                    f"carries {spec.series or spec.kind!r} "
+                    f"(missing={spec.missing})", spec))
+                continue
+            # every in-scope snapshot must satisfy the objective; report
+            # the worst offender as the measured value
+            failing = [(v, w) for v, w in hits if not cmp_op(v, spec.value)]
+            if failing:
+                measured, where = failing[0]
+                results.append(SloResult(
+                    spec.name, False, measured,
+                    f"{spec.series or spec.kind}={measured:g} violates "
+                    f"{spec.op} {spec.value:g} (lane {where})", spec))
+            else:
+                measured, where = hits[0]
+                results.append(SloResult(
+                    spec.name, True, measured,
+                    f"{spec.series or spec.kind}={measured:g} {spec.op} "
+                    f"{spec.value:g} ({len(hits)} snapshot(s))", spec))
+        else:  # overhead
+            if overhead_ns is not None:
+                measured = float(overhead_ns)
+            elif _trace.current_tracer() is not None:
+                results.append(SloResult(
+                    spec.name, True, None,
+                    "tracer installed; disabled-mode overhead not "
+                    "measurable in-process (skipped)", spec))
+                continue
+            else:
+                measured = measure_disabled_span_ns()
+            ok = bool(cmp_op(measured, spec.value))
+            results.append(SloResult(
+                spec.name, ok, measured,
+                f"disabled span() = {measured:.0f} ns {spec.op} "
+                f"{spec.value:g} ns", spec))
+    return results
+
+
+def summarize(results: list) -> dict:
+    """Compact verdict for embedding in a bench record."""
+    violations = [r.name for r in results if not r.ok]
+    return {"pass": sum(r.ok for r in results),
+            "fail": len(violations),
+            "violations": violations}

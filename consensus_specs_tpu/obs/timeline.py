@@ -1,0 +1,141 @@
+"""Perfetto/Chrome-trace timeline export of the finished-span ring.
+
+Finished spans (obs/trace.py — each carries monotonic start, duration,
+thread name/id, optional TraceContext and span links) render into the
+Chrome trace event format (the JSON Perfetto and chrome://tracing both
+load): one "X" complete event per span, laned by THREAD, so the
+producer/flusher overlap of the firehose's double-buffered flush is
+visible as two parallel tracks instead of an interleaved log.
+
+Requests are followed ACROSS lanes with flow events ("s"/"t"/"f" with a
+shared id): every span that carries a trace id — in its own context or in
+a span link — joins that request's flow, so clicking one sampled
+attestation's arrow chain walks ingest (producer lane) → aggregate →
+flush → sched.dispatch (flusher lane) → resolve. That chain is the
+acceptance artifact: one timeline export reconstructs a verdict's full
+path across threads.
+
+Two on-disk forms:
+  * span dump — `{"version": 1, "kind": "spans", "spans": [...]}` in the
+    canonical-JSON serialization (obs/export.py), the raw material tests
+    and benches persist;
+  * chrome trace — `{"traceEvents": [...]}`, what
+    `tools/obs_dump.py trace` emits from a span dump.
+
+jax-free at module level by charter (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from . import export as _export
+
+SPAN_DUMP_VERSION = 1
+
+_US = 1e6  # chrome trace timestamps are microseconds
+
+
+def span_dump_dict(spans: list, meta: Optional[dict] = None) -> dict:
+    """The persistable span-dump artifact for a list of finished-span
+    dicts (Tracer.spans())."""
+    return {"version": SPAN_DUMP_VERSION, "kind": "spans",
+            "spans": [dict(s) for s in spans], "meta": dict(meta or {})}
+
+
+def write_span_dump(path, spans: list, meta: Optional[dict] = None) -> str:
+    text = _export.canonical_json(span_dump_dict(spans, meta))
+    with open(path, "w") as f:
+        f.write(text)
+    return text
+
+
+def load_span_dump(text: str) -> list:
+    """Parse + validate a span dump; returns the span dicts. Raises
+    ValueError on anything that is not a canonical span dump."""
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError as exc:
+        raise ValueError(f"not JSON: {exc}") from exc
+    if not isinstance(obj, dict) or obj.get("kind") != "spans":
+        raise ValueError('not a span dump (kind != "spans")')
+    if obj.get("version") != SPAN_DUMP_VERSION:
+        raise ValueError(
+            f"span dump version {obj.get('version')!r} != {SPAN_DUMP_VERSION}")
+    spans = obj.get("spans")
+    if not isinstance(spans, list):
+        raise ValueError("missing spans list")
+    return spans
+
+
+def _span_trace_ids(span: dict) -> list:
+    """Every trace id a span participates in: its own context plus every
+    span link (fan-in/fan-out membership)."""
+    ids = []
+    if span.get("trace_id"):
+        ids.append(span["trace_id"])
+    for link in span.get("links") or []:
+        tid = link.get("trace_id")
+        if tid and tid not in ids:
+            ids.append(tid)
+    return ids
+
+
+def chrome_trace(spans: list, *, flows: bool = True) -> dict:
+    """Render finished-span dicts into a Chrome trace event object.
+
+    Lanes: one tid per (thread name, thread id) pair, assigned in sorted
+    order so equal inputs render identically; thread_name metadata events
+    label them. Flows: one flow chain per trace id across every span that
+    carries it (context or link), emitted only when the trace touches >= 2
+    spans — a single-span request has no cross-lane arrow to draw."""
+    spans = [s for s in spans if s.get("t_start") is not None]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(s["t_start"] for s in spans)
+    threads = sorted({(s.get("thread") or "main", s.get("thread_id") or 0)
+                      for s in spans})
+    tid_of = {th: i + 1 for i, th in enumerate(threads)}
+    events: list[dict] = []
+    for (name, ident), tid in sorted(tid_of.items(), key=lambda kv: kv[1]):
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": tid, "args": {"name": name or f"tid-{ident}"}})
+
+    def _tid(s: dict) -> int:
+        return tid_of[(s.get("thread") or "main", s.get("thread_id") or 0)]
+
+    by_trace: dict[str, list] = {}
+    for s in spans:
+        ts = round((s["t_start"] - t0) * _US, 3)
+        dur = round(max(s.get("duration") or 0.0, 0.0) * _US, 3)
+        args = dict(s.get("attrs") or {})
+        for k in ("trace_id", "span_id", "parent_span_id", "status"):
+            if s.get(k) is not None:
+                args[k] = s[k]
+        if s.get("links"):
+            args["links"] = [link.get("trace_id") for link in s["links"]]
+        events.append({"name": s["name"], "ph": "X", "ts": ts, "dur": dur,
+                       "pid": 1, "tid": _tid(s), "cat": "span",
+                       "args": args})
+        for trace_id in _span_trace_ids(s):
+            by_trace.setdefault(trace_id, []).append((ts, dur, _tid(s)))
+    if flows:
+        for trace_id, hits in sorted(by_trace.items()):
+            if len(hits) < 2:
+                continue
+            hits.sort()
+            for i, (ts, dur, tid) in enumerate(hits):
+                ph = "s" if i == 0 else ("f" if i == len(hits) - 1 else "t")
+                ev = {"name": "request", "ph": ph, "id": trace_id,
+                      "cat": "request", "ts": ts, "pid": 1, "tid": tid}
+                if ph == "f":
+                    ev["bp"] = "e"  # bind the finish to the enclosing slice
+                events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, spans: list) -> str:
+    text = _export.canonical_json(chrome_trace(spans))
+    with open(path, "w") as f:
+        f.write(text)
+    return text

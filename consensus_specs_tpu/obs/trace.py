@@ -17,6 +17,16 @@ Thread model: the active-span stack is thread-local (gossip rx threads each
 get their own nesting chain); the finished-span ring and the registry are
 shared and locked. The ring is FIXED SIZE with a drop counter — same
 bounded-memory rule as the breaker event log and the metrics histograms.
+
+Causality (PR 13): a span may carry a `TraceContext` (obs/context.py) —
+the request identity minted at ingest — and *links* to other contexts,
+expressing fan-in (N collapsed requests → one dispatch span) and fan-out
+(one failed collapse → N reverify attributions). Finished spans also
+record their thread name/id and monotonic start time, which is what the
+timeline exporter (obs/timeline.py) renders into per-thread lanes with
+flow events following a request across them. All of it rides the same
+disabled-mode contract: no tracer ⇒ `span(...)` still returns the shared
+no-op singleton and nothing mints, links, or records.
 """
 from __future__ import annotations
 
@@ -24,6 +34,7 @@ import threading
 import time
 from typing import Optional
 
+from . import flight as _flight
 from .metrics import REGISTRY, MetricsRegistry
 
 
@@ -42,6 +53,9 @@ class _NullSpan:
     def set(self, **attrs):
         return self
 
+    def link(self, ctx):
+        return self
+
     @property
     def attrs(self):
         return {}
@@ -54,10 +68,11 @@ class Span:
     """One live (or finished) span. Created only by an installed Tracer."""
 
     __slots__ = ("name", "attrs", "depth", "parent", "t_start", "duration",
-                 "status", "_tracer")
+                 "status", "ctx", "links", "thread", "thread_id", "_tracer")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict,
-                 depth: int, parent: Optional[str]):
+                 depth: int, parent: Optional[str],
+                 ctx=None, links=None):
         self.name = name
         self.attrs = attrs
         self.depth = depth
@@ -65,14 +80,28 @@ class Span:
         self.t_start = 0.0
         self.duration = 0.0
         self.status = "ok"
+        self.ctx = ctx
+        self.links = list(links) if links else []
+        self.thread = ""
+        self.thread_id = 0
         self._tracer = tracer
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
         return self
 
+    def link(self, ctx) -> "Span":
+        """Add a span link to another request's context — fan-in/fan-out
+        causality the parent/child nesting cannot express."""
+        if ctx is not None:
+            self.links.append(ctx)
+        return self
+
     def __enter__(self) -> "Span":
         self._tracer._push(self)
+        th = threading.current_thread()
+        self.thread = th.name
+        self.thread_id = th.ident or 0
         self.t_start = time.monotonic()
         return self
 
@@ -85,12 +114,22 @@ class Span:
         return False
 
     def to_dict(self) -> dict:
+        ctx = self.ctx
         return {
             "name": self.name,
             "depth": self.depth,
             "parent": self.parent,
+            "t_start": self.t_start,
             "duration": self.duration,
             "status": self.status,
+            "thread": self.thread,
+            "thread_id": self.thread_id,
+            "trace_id": ctx.trace_id if ctx is not None else None,
+            "span_id": ctx.span_id if ctx is not None else None,
+            "parent_span_id": (ctx.parent_span_id
+                               if ctx is not None else None),
+            "links": [{"trace_id": c.trace_id, "span_id": c.span_id}
+                      for c in self.links],
             "attrs": dict(self.attrs),
         }
 
@@ -146,13 +185,22 @@ class Tracer:
         self.registry.counter("span_total", span=sp.name).inc()
         if sp.status == "error":
             self.registry.counter("span_errors_total", span=sp.name).inc()
-        self.registry.histogram("span_seconds", span=sp.name).observe(sp.duration)
+        self.registry.histogram("span_seconds", span=sp.name).observe(
+            sp.duration,
+            exemplar=(sp.ctx.trace_id if sp.ctx is not None else None))
+        # black box: span completions are flight-recorder events, so a dump
+        # shows what the pipeline was DOING just before the trigger
+        _flight.record("span", name=sp.name, status=sp.status,
+                       duration=round(sp.duration, 6),
+                       trace_id=(sp.ctx.trace_id
+                                 if sp.ctx is not None else None))
 
-    def span(self, name: str, **attrs) -> Span:
+    def span(self, name: str, ctx=None, links=None, **attrs) -> Span:
         cur = self.current()
         return Span(self, name, attrs,
                     depth=(cur.depth + 1 if cur is not None else 0),
-                    parent=(cur.name if cur is not None else None))
+                    parent=(cur.name if cur is not None else None),
+                    ctx=ctx, links=links)
 
     def spans(self, name: Optional[str] = None) -> list[dict]:
         """Finished spans (optionally filtered by name), oldest first."""
@@ -186,13 +234,15 @@ def uninstall() -> None:
     _TRACER = None
 
 
-def span(name: str, **attrs):
+def span(name: str, ctx=None, links=None, **attrs):
     """THE hot-path entry point. Disabled: one global read + shared no-op
-    object. Enabled: a real nested span."""
+    object (ctx/links ignored — callers gate minting on `current_tracer()`
+    so nothing is even built). Enabled: a real nested span carrying the
+    request context and any fan-in/fan-out links."""
     tracer = _TRACER
     if tracer is None:
         return NULL_SPAN
-    return tracer.span(name, **attrs)
+    return tracer.span(name, ctx=ctx, links=links, **attrs)
 
 
 def annotate(**attrs) -> None:

@@ -26,6 +26,7 @@ jax-free at module level (tpulint import-layering).
 """
 from __future__ import annotations
 
+from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 
 CLOSED = "closed"
@@ -117,6 +118,14 @@ class CircuitBreaker:
         if self.events.dropped > before:
             reg.counter("breaker_events_dropped_total",
                         breaker=self.name).inc(self.events.dropped - before)
+        # black box: every transition is a flight-recorder event, and an
+        # OPEN is an incident — dump the ring exactly once per transition
+        # (the state != OPEN guard in record_failure already guarantees
+        # one "opened" per open, so this stays one dump per incident)
+        _flight.record("breaker", breaker=self.name, event=event,
+                       consecutive_failures=self.consecutive_failures)
+        if event == "opened":
+            _flight.dump("breaker_open", meta={"breaker": self.name})
 
     def __repr__(self) -> str:  # observability in test failures
         return (f"CircuitBreaker({self.name!r}, state={self.state}, "

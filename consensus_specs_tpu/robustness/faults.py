@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs_trace
 
@@ -174,6 +175,10 @@ class FaultPlan:
         # injected failure landed, not just that one did.
         _obs_metrics.REGISTRY.counter("fault_fires_total", site=site).inc()
         _obs_trace.annotate(fault_sites=site)
+        # ...and a flight-recorder event, so a black-box dump shows every
+        # injected failure that preceded the trigger — reconciled 1:1
+        # against plan.fires(site) by the chaos lane, same as the counter
+        _flight.record("fault", site=site, call=ix, action=action)
 
     def install(self) -> "FaultPlan":
         global _PLAN

@@ -34,6 +34,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from random import Random
 
+from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 from ..testlib.fork_choice import checks_snapshot
 from .history import ScenarioHistory
@@ -382,7 +383,20 @@ def firehose_lane(history: ScenarioHistory, *, registry=None,
 def assert_converged(results: list) -> None:
     """Every lane must agree bit-identically on every checkpoint — state
     roots, heads, justified/finalized checkpoints, boost — and on the
-    reorg transcript (count + max depth)."""
+    reorg transcript (count + max depth). A divergence is an incident:
+    the flight recorder dumps its black box before the assertion
+    propagates, so the post-mortem has the event history without
+    re-running the scenario."""
+    try:
+        _check_converged(results)
+    except AssertionError as exc:
+        lanes = [getattr(r, "name", "?") for r in results]
+        _flight.record("divergence", lanes=lanes, error=str(exc)[:500])
+        _flight.dump("scenario_divergence", meta={"lanes": lanes})
+        raise
+
+
+def _check_converged(results: list) -> None:
     assert results, "no lanes to compare"
     base = results[0]
     for other in results[1:]:

@@ -35,6 +35,12 @@ class Request:
     kind: str
     payload: tuple
     group_key: Optional[Hashable] = None
+    # trace: the submitter's TraceContext (obs/context.py), when tracing is
+    # on. The scheduler never reads it for scheduling decisions — it only
+    # links the dispatch/reverify spans back to every member request, and
+    # stamps latency-histogram exemplars, so a verdict stays attributable
+    # through admission collapse. Handles reach it via `handle.request`.
+    trace: Optional[Any] = None
 
 
 @dataclass

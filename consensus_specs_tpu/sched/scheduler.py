@@ -37,6 +37,7 @@ import time
 
 import numpy as np
 
+from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 from ..obs import trace as _obs_trace
 from ..robustness import breaker as _breaker
@@ -311,8 +312,15 @@ class Scheduler:
         reg = self.registry
         requests = [e.collapsed for e in entries]
         brk = self._breakers[wc.name]
+        # fan-in span links: N member requests (across every admission
+        # collapse in the batch) -> ONE dispatch span, so a device verdict
+        # is attributable to exactly the traces that rode this batch
+        links = None
+        if _obs_trace.current_tracer() is not None:
+            links = [m.trace for e in entries for m in e.members
+                     if m.trace is not None] or None
         with _obs_trace.span("sched.dispatch", work_class=wc.name,
-                             batch=len(requests)):
+                             batch=len(requests), links=links):
             mode = brk.on_attempt()
             n = len(requests)
 
@@ -325,9 +333,18 @@ class Scheduler:
                 # raises a retryable error so corrupt-but-well-formed rows
                 # re-execute or degrade instead of resolving handles. The
                 # degraded path below skips it — the host oracle is the
-                # trust anchor the check compares against.
+                # trust anchor the check compares against. A rejection is
+                # an incident: the black box freezes its event ring.
                 if wc.verify_results is not None:
-                    wc.verify_results(requests, res)
+                    try:
+                        wc.verify_results(requests, res)
+                    except Exception as exc:
+                        _flight.record("self_check", work_class=wc.name,
+                                       error=type(exc).__name__,
+                                       detail=str(exc)[:200])
+                        _flight.dump("sched_self_check",
+                                     meta={"work_class": wc.name})
+                        raise
                 return res
 
             degraded = False
@@ -366,22 +383,36 @@ class Scheduler:
         lat = self.registry.histogram(
             "sched_submit_latency_seconds", work_class=wc.name)
         now = time.monotonic()
+
+        def _ex(h):
+            tr = h.request.trace
+            return tr.trace_id if tr is not None else None
+
         for e, row in zip(entries, results):
             if len(e.members) > 1 and not wc.to_result(row):
                 # a failing collapsed check proves nothing about members:
-                # re-verify each for sound attribution (Wonderboom fallback)
+                # re-verify each for sound attribution (Wonderboom
+                # fallback). Fan-out span links name the EXACT member set
+                # the failure decomposes into — the reverse edge of the
+                # dispatch span's fan-in.
                 self.registry.counter("sched_collapse_reverify_total",
                                       work_class=wc.name).inc()
                 runner = wc.execute_degraded if degraded else wc.execute
-                member_rows = self._validated(
-                    np.asarray(runner(e.members)), len(e.members), wc.name)
+                mlinks = [m.trace for m in e.members if m.trace is not None]
+                with _obs_trace.span("sched.reverify", work_class=wc.name,
+                                     members=len(e.members),
+                                     links=mlinks or None):
+                    member_rows = self._validated(
+                        np.asarray(runner(e.members)), len(e.members),
+                        wc.name)
                 for h, mrow in zip(e.handles, member_rows):
-                    lat.observe(max(0.0, now - h._submitted_at))
+                    lat.observe(max(0.0, now - h._submitted_at),
+                                exemplar=_ex(h))
                     h._resolve(wc.to_result(mrow))
                 continue
             value = wc.to_result(row)
             for h in e.handles:
-                lat.observe(max(0.0, now - h._submitted_at))
+                lat.observe(max(0.0, now - h._submitted_at), exemplar=_ex(h))
                 h._resolve(value)
 
     def _validated(self, res: np.ndarray, n: int, name: str) -> np.ndarray:

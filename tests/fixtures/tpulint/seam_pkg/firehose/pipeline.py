@@ -1,0 +1,33 @@
+"""seam-coverage fixtures for the ISSUE-13 context-propagation call shape.
+
+The firehose ingest seam now mints a TraceContext and passes it to the
+wrapping span (`span("firehose.ingest", ctx=ctx)`); the flush fan-in
+passes the collapsed members' contexts as links. Both are ordinary
+`with span(...)` scopes to the analyzer — the kwargs must not confuse
+span detection — so `covered_ingest`/`covered_flush_fanin` stay clean,
+while minting a context does NOT count as coverage by itself:
+`uncovered_mint_only` propagates causality but never opens a span.
+"""
+from seam_pkg.obs.context import mint_trace
+from seam_pkg.obs.trace import span
+from seam_pkg.robustness.faults import fire
+
+
+def covered_ingest(item):
+    ctx = mint_trace()
+    with span("firehose.ingest", ctx=ctx):
+        fire("firehose.ingest")
+    return item
+
+
+def covered_flush_fanin(items):
+    links = [mint_trace() for _ in items]
+    with span("firehose.flush", batch=len(items), links=links):
+        fire("firehose.flush")
+    return items
+
+
+def uncovered_mint_only(item):
+    ctx = mint_trace()
+    fire("firehose.ingest")  # tpulint-expect: seam-coverage
+    return item, ctx

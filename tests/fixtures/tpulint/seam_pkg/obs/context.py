@@ -1,0 +1,12 @@
+"""Minimal trace-context shim mirroring consensus_specs_tpu/obs/context.py."""
+
+
+class TraceContext:
+    def __init__(self, trace_id, span_id, parent_span_id=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+
+
+def mint_trace():
+    return TraceContext("t0", "s0")

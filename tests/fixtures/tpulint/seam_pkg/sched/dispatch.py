@@ -1,0 +1,25 @@
+"""seam-coverage fixtures for the sched.dispatch fan-in link shape.
+
+The dispatch span carries links to every collapsed member's TraceContext
+(`span("sched.dispatch", links=links)`); the seam inside it is covered.
+Building the links list is propagation plumbing, not coverage: a seam
+fired while assembling links outside any span is still naked.
+"""
+from seam_pkg.obs.context import mint_trace
+from seam_pkg.obs.trace import span
+from seam_pkg.robustness.faults import fire
+
+
+def covered_dispatch(entries):
+    links = [mint_trace() for _ in entries]
+    with span("sched.dispatch", batch=len(entries), links=links):
+        fire("sched.dispatch")
+    return entries
+
+
+def uncovered_link_assembly(entries):
+    links = []
+    for _ in entries:
+        links.append(mint_trace())
+        fire("sched.dispatch")  # tpulint-expect: seam-coverage
+    return links

@@ -157,6 +157,61 @@ def test_prometheus_text_shape():
                for l in lines)
 
 
+# --- histogram exemplars (ISSUE 13) ------------------------------------------
+
+
+def test_exemplars_off_snapshot_is_byte_identical():
+    """The exemplar feature must be invisible until used: a registry whose
+    histograms never received an exemplar snapshots to the EXACT bytes the
+    pre-exemplar format produced (no empty "exemplars" keys)."""
+    a = obs_export.json_snapshot(_populated_registry())
+    assert '"exemplars"' not in a
+    ok, reason = obs_export.validate_snapshot_text(a)
+    assert ok, reason
+
+
+def test_exemplar_links_fat_bucket_to_trace_id():
+    r = _populated_registry()
+    h = r.histogram("span_seconds", span="engine.dispatch")
+    h.observe(0.7, exemplar="t00000042")  # lands near the p99 tail
+    h.observe(1e-4)                        # exemplar-less: bucket unchanged
+    snap = obs_export.snapshot_dict(r)
+    ex = snap["histograms"]['span_seconds{span="engine.dispatch"}']["exemplars"]
+    assert list(ex.values()) == ["t00000042"]
+    (le,) = ex.keys()
+    assert le == "+Inf" or float(le) >= 0.7
+    # later observation into the same bucket replaces the exemplar
+    h.observe(0.7, exemplar="t00000043")
+    snap2 = obs_export.snapshot_dict(r)
+    ex2 = snap2["histograms"][
+        'span_seconds{span="engine.dispatch"}']["exemplars"]
+    assert list(ex2.values()) == ["t00000043"]
+
+
+def test_exemplars_are_json_only_and_exporters_still_agree():
+    """Exemplars ride the JSON snapshot, never the Prometheus text, and
+    the exporter-agreement value-set invariant is untouched by them."""
+    r = _populated_registry()
+    r.histogram("span_seconds", span="engine.dispatch").observe(
+        0.5, exemplar="t00000007")
+    snap = obs_export.snapshot_dict(r)
+    prom = obs_export.prometheus_text(snap)
+    assert "t00000007" not in prom and "exemplar" not in prom
+    assert (obs_export.snapshot_value_set(snap)
+            == obs_export.prometheus_value_set(prom))
+    text = obs_export.json_snapshot(r)
+    ok, reason = obs_export.validate_snapshot_text(text)
+    assert ok, reason
+
+
+def test_exemplars_cleared_by_reset():
+    r = _populated_registry()
+    h = r.histogram("span_seconds", span="engine.dispatch")
+    h.observe(0.5, exemplar="t00000001")
+    r.reset()
+    assert '"exemplars"' not in obs_export.json_snapshot(r)
+
+
 # --- tracing -----------------------------------------------------------------
 
 
@@ -430,6 +485,35 @@ def test_obs_dump_table_groups_by_subsystem_prefix(tmp_path):
     # every series line is indented under some group header
     body = [ln for ln in lines if ln and not ln.startswith(("[", "meta:"))]
     assert all(ln.startswith("  ") for ln in body)
+
+
+def test_obs_dump_table_top_ranks_hottest_first(tmp_path):
+    """--top N drops the grouping: counters/gauges ranked by value,
+    histograms by p99, truncated to N each — the incident view."""
+    r = MetricsRegistry()
+    r.counter("cold_total").inc(1)
+    r.counter("warm_total").inc(50)
+    r.counter("hot_total").inc(900)
+    r.gauge("depth").set(70)
+    r.histogram("fast_seconds").observe(1e-4)
+    r.histogram("slow_seconds").observe(2.0)
+    path = tmp_path / "snap.json"
+    obs_export.write_snapshot(path, r)
+    res = _run_dump("table", str(path), "--top", "2")
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.splitlines()
+    assert lines[0] == "[top 2 counters/gauges by value]"
+    scalar_keys = [ln.split()[0] for ln in lines[1:3]]
+    assert scalar_keys == ["hot_total", "depth"]  # 900, then 70; cold cut
+    assert "cold_total" not in res.stdout
+    hix = lines.index("[top 2 histograms by p99]")
+    hist_keys = [ln.split()[0] for ln in lines[hix + 1:hix + 3]]
+    assert hist_keys == ["slow_seconds", "fast_seconds"]
+    assert "p99=" in lines[hix + 1]
+    # top larger than the series count: everything, still ranked
+    res_all = _run_dump("table", str(path), "--top", "99")
+    assert res_all.returncode == 0
+    assert "cold_total" in res_all.stdout
 
 
 def test_obs_dump_check_fails_loudly_on_corruption(tmp_path):

@@ -1,0 +1,544 @@
+"""Causal request tracing, flight recorder, timeline export, SLO gate.
+
+The ISSUE-13 acceptance surface, end to end:
+
+  1. CAUSALITY — a TraceContext minted at firehose ingest rides the
+     AttestationItem and sched Request across the producer/flusher thread
+     boundary; span links express the fan-in of N requests into one
+     collapsed dispatch and the fan-out of a failed collapse into the
+     EXACT per-member reverify set; a sampled attestation's full
+     ingest → aggregate → flush → dispatch → resolve path is
+     reconstructable from one timeline export.
+  2. FLIGHT RECORDER — the always-on bounded event ring dumps a
+     canonical-JSON black box on its triggers (breaker open, firehose
+     kill, scenario divergence), exactly once per incident, and the
+     dump's ring reconciles 1:1 against plan.fires(site) — the PR-6
+     reconciliation discipline extended to the black box.
+  3. SLO GATE — slo.json evaluates green on the shipped evidence and
+     red (rc != 0, named SLO) on a doctored snapshot.
+
+Synthetic committee traffic reuses the aggregate-identity trick from
+tests/test_firehose.py (one pure-Python Sign per payload, BLS pinned to
+the host oracle path — no device pairing compile in this tier).
+"""
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from consensus_specs_tpu.crypto import bls_sig
+from consensus_specs_tpu.firehose import (
+    AttestationFirehose,
+    AttestationItem,
+    ClassifyError,
+    FirehoseConfig,
+)
+from consensus_specs_tpu.obs import export as obs_export
+from consensus_specs_tpu.obs import flight as obs_flight
+from consensus_specs_tpu.obs import slo as obs_slo
+from consensus_specs_tpu.obs import timeline as obs_timeline
+from consensus_specs_tpu.obs import trace as obs_trace
+from consensus_specs_tpu.obs.context import TraceContext, mint_trace
+from consensus_specs_tpu.obs.flight import FlightRecorder
+from consensus_specs_tpu.obs.metrics import MetricsRegistry
+from consensus_specs_tpu.parallel.gossip_driver import message_id
+from consensus_specs_tpu.robustness.breaker import CircuitBreaker
+from consensus_specs_tpu.robustness.faults import (
+    FaultPlan,
+    FaultSpec,
+    uninstall,
+)
+from consensus_specs_tpu.robustness.retry import RetryPolicy
+from consensus_specs_tpu.scenarios.lanes import LaneResult, assert_converged
+from consensus_specs_tpu.sched import BlsWorkClass, Scheduler
+
+REPO = Path(__file__).resolve().parents[1]
+
+FAST_RETRY = RetryPolicy(max_attempts=4, base_delay=0.0, backoff=1.0,
+                         max_delay=0.0, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    """Fresh tracer-less, plan-less world with an isolated flight recorder
+    per test — nothing leaks into the session recorder or other tests."""
+    rec = FlightRecorder(registry=MetricsRegistry()).install()
+    yield rec
+    rec.uninstall()
+    obs_trace.uninstall()
+    uninstall()
+
+
+class HostBls(BlsWorkClass):
+    def execute(self, requests):
+        return self.execute_degraded(requests)
+
+
+SKS = list(range(61, 69))
+PKS = [bls_sig.SkToPk(sk) for sk in SKS]
+
+
+def _payload(committee: int, signers, *, good: bool = True) -> bytes:
+    msg = ("causal-%d-root" % committee).encode()
+    sk = sum(SKS[i] for i in signers)
+    sig = bls_sig.Sign(sk if good else sk + 1, msg)
+    return json.dumps({"c": committee, "s": sorted(signers), "m": msg.hex(),
+                       "sig": sig.hex()}).encode()
+
+
+def _classify(raw: bytes) -> AttestationItem:
+    try:
+        d = json.loads(raw)
+        msg = bytes.fromhex(d["m"])
+        return AttestationItem(
+            msg_id=message_id(bytes(raw)),
+            key=(0, d["c"], msg[:8]),
+            pubkeys=tuple(PKS[i] for i in d["s"]),
+            message=msg,
+            signature=bytes.fromhex(d["sig"]),
+            ssz=bytes(raw))
+    except Exception as exc:
+        raise ClassifyError(str(exc)) from exc
+
+
+def _firehose(*, threaded, registry=None, **cfg_kw):
+    reg = registry if registry is not None else MetricsRegistry()
+    sch = Scheduler(classes=[HostBls(collapse_same_message=True)],
+                    retry_policy=FAST_RETRY, max_depth=1 << 30, registry=reg)
+    defaults = dict(batch_attestations=4, max_pending=8,
+                    flush_deadline_s=0.01, backpressure_wait_s=0.05)
+    defaults.update(cfg_kw)
+    fh = AttestationFirehose(_classify, scheduler=sch, registry=reg,
+                             config=FirehoseConfig(**defaults),
+                             retry_policy=FAST_RETRY, threaded=threaded)
+    return fh, reg
+
+
+# --- TraceContext ------------------------------------------------------------
+
+
+def test_mint_trace_is_unique_and_parentless():
+    a, b = mint_trace(), mint_trace()
+    assert a.trace_id != b.trace_id
+    assert a.span_id != b.span_id
+    assert a.parent_span_id is None
+
+
+def test_child_context_stays_in_trace_and_parents_on_the_fork_point():
+    root = mint_trace()
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.span_id != root.span_id
+    assert kid.parent_span_id == root.span_id
+
+
+def test_context_dict_round_trip():
+    ctx = mint_trace().child()
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+
+
+def test_disabled_span_stays_the_shared_noop_singleton():
+    """The PR-6 contract with propagation compiled in: no tracer means
+    span(ctx=..., links=...) still returns THE no-op instance and link()
+    chains on it without allocating."""
+    assert obs_trace.current_tracer() is None
+    sp = obs_trace.span("firehose.ingest", ctx=None, links=None)
+    assert sp is obs_trace.NULL_SPAN
+    assert sp.link(mint_trace()) is obs_trace.NULL_SPAN
+
+
+def test_span_records_context_links_and_thread():
+    tracer = obs_trace.Tracer(registry=MetricsRegistry()).install()
+    try:
+        ctx = mint_trace()
+        other = mint_trace()
+        with obs_trace.span("sched.dispatch", ctx=ctx, links=[other]) as sp:
+            sp.link(None)  # ignored
+        (rec,) = tracer.spans("sched.dispatch")
+    finally:
+        tracer.uninstall()
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["span_id"] == ctx.span_id
+    assert rec["links"] == [{"trace_id": other.trace_id,
+                             "span_id": other.span_id}]
+    assert rec["thread"] and rec["thread_id"]
+    assert rec["t_start"] > 0.0
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_flight_ring_bounds_with_drop_counter():
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=4, registry=reg, keep_dumps=2)
+    for i in range(10):
+        rec.record("sample", i=i)
+    evs = rec.events()
+    assert len(evs) == 4 and rec.dropped == 6
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]  # oldest dropped first
+    assert evs[-1]["seq"] == 10
+
+
+def test_flight_dump_is_canonical_counted_and_retained():
+    reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=16, registry=reg, keep_dumps=2)
+    rec.record("fault", site="engine.dispatch", call=1)
+    for trigger in ("breaker_open", "firehose_killed", "sched_self_check"):
+        art = rec.dump(trigger, meta={"why": "test"})
+        # the artifact must survive the canonical serializer (sorted keys,
+        # no NaN) — this is what lands on disk for CI upload
+        obs_export.canonical_json(art)
+        assert art["version"] == obs_flight.DUMP_VERSION
+        assert art["trigger"] == trigger
+        assert art["events"][0]["site"] == "engine.dispatch"
+    assert len(rec.dumps) == 2  # keep_dumps bound
+    for trigger in ("breaker_open", "firehose_killed", "sched_self_check"):
+        assert reg.counter_value("flight_dumps_total", trigger=trigger) == 1
+
+
+def test_flight_dump_writes_artifact_file(tmp_path, monkeypatch):
+    monkeypatch.setenv("OBS_FLIGHT_DIR", str(tmp_path))
+    rec = FlightRecorder(registry=MetricsRegistry())
+    rec.record("breaker", breaker="t", event="opened")
+    art = rec.dump("breaker_open", meta={"breaker": "t"})
+    (path,) = tmp_path.glob("flight_breaker_open_*.json")
+    assert path.read_text() == obs_export.canonical_json(art)
+
+
+def test_breaker_open_dumps_black_box_exactly_once(_isolated_obs):
+    brk = CircuitBreaker(failure_threshold=2, name="bb-test")
+    brk.record_failure()          # below threshold: no incident yet
+    assert _isolated_obs.dumps == []
+    brk.record_failure()          # threshold: OPEN — one dump
+    brk.record_failure()          # already open: no second dump
+    dumps = [d for d in _isolated_obs.dumps if d["trigger"] == "breaker_open"]
+    assert len(dumps) == 1
+    assert dumps[0]["meta"] == {"breaker": "bb-test"}
+    kinds = [e["event"] for e in dumps[0]["events"] if e["kind"] == "breaker"]
+    assert "opened" in kinds
+
+
+def test_scenario_divergence_dumps_black_box(_isolated_obs):
+    a = LaneResult(name="oracle", checkpoints=[{"epoch": 1, "root": "aa"}])
+    b = LaneResult(name="engine", checkpoints=[{"epoch": 1, "root": "bb"}])
+    with pytest.raises(AssertionError):
+        assert_converged([a, b])
+    (dump,) = [d for d in _isolated_obs.dumps
+               if d["trigger"] == "scenario_divergence"]
+    assert dump["meta"]["lanes"] == ["oracle", "engine"]
+    (ev,) = [e for e in dump["events"] if e["kind"] == "divergence"]
+    assert "diverged" in ev["error"]
+
+
+# --- timeline export ---------------------------------------------------------
+
+
+def _synthetic_spans():
+    tid = "t00000042"
+    return [
+        {"name": "firehose.ingest", "t_start": 1.0, "duration": 0.001,
+         "status": "ok", "thread": "producer", "thread_id": 11,
+         "trace_id": tid, "span_id": "s1", "parent_span_id": None,
+         "links": [], "attrs": {"n": 1}},
+        {"name": "sched.dispatch", "t_start": 1.01, "duration": 0.002,
+         "status": "ok", "thread": "flusher", "thread_id": 22,
+         "trace_id": None, "span_id": None, "parent_span_id": None,
+         "links": [{"trace_id": tid, "span_id": "s1"}], "attrs": {}},
+        {"name": "firehose.resolve", "t_start": 1.02, "duration": 0.001,
+         "status": "ok", "thread": "flusher", "thread_id": 22,
+         "trace_id": None, "span_id": None, "parent_span_id": None,
+         "links": [{"trace_id": tid, "span_id": "s1"}], "attrs": {}},
+    ]
+
+
+def test_chrome_trace_lanes_and_flow_chain():
+    out = obs_timeline.chrome_trace(_synthetic_spans())
+    evs = out["traceEvents"]
+    lanes = {e["args"]["name"]: e["tid"] for e in evs if e["ph"] == "M"}
+    assert set(lanes) == {"producer", "flusher"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"firehose.ingest", "sched.dispatch",
+                                      "firehose.resolve"}
+    # the request's flow chain: start in the producer lane, finish in the
+    # flusher lane, every hop carrying the trace id
+    flows = [e for e in evs if e.get("cat") == "request"]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert {e["id"] for e in flows} == {"t00000042"}
+    assert flows[0]["tid"] == lanes["producer"]
+    assert flows[-1]["tid"] == lanes["flusher"]
+    assert flows[-1]["bp"] == "e"
+    # deterministic render: canonical bytes are stable across calls
+    assert (obs_export.canonical_json(out)
+            == obs_export.canonical_json(
+                obs_timeline.chrome_trace(_synthetic_spans())))
+
+
+def test_span_dump_round_trip_and_rejects_garbage(tmp_path):
+    spans = _synthetic_spans()
+    path = tmp_path / "spans.json"
+    obs_timeline.write_span_dump(path, spans, meta={"lane": "test"})
+    assert obs_timeline.load_span_dump(path.read_text()) == spans
+    with pytest.raises(ValueError):
+        obs_timeline.load_span_dump("not json {")
+    with pytest.raises(ValueError):
+        obs_timeline.load_span_dump('{"kind": "snacks"}')
+    with pytest.raises(ValueError):
+        obs_timeline.load_span_dump('{"kind": "spans", "version": 99}')
+
+
+# --- the acceptance artifact: one export, full path, across threads ----------
+
+
+def test_threaded_firehose_path_reconstructable_from_one_export(tmp_path):
+    """A sampled attestation's trace id connects its ingest span (producer
+    thread) to the aggregate fan-in, the sched dispatch, and the resolve
+    span (flusher thread) in a single timeline export."""
+    tracer = obs_trace.Tracer(registry=MetricsRegistry(),
+                              max_spans=65536).install()
+    try:
+        payloads = [_payload(0, [0]), _payload(0, [1]), _payload(0, [0, 1]),
+                    _payload(1, [2]), _payload(1, [3]), _payload(1, [2, 3])]
+        fh, _ = _firehose(threaded=True)
+        with fh:
+            fh.offer_many(payloads)
+        spans = tracer.spans()
+    finally:
+        tracer.uninstall()
+
+    ingests = [s for s in spans if s["name"] == "firehose.ingest"]
+    assert len(ingests) == len(payloads)
+    assert all(s["trace_id"] for s in ingests)
+    # sample one request and follow its trace id through the pipeline
+    tid = ingests[0]["trace_id"]
+
+    def carries(s):
+        return (s["trace_id"] == tid
+                or any(li["trace_id"] == tid for li in s["links"]))
+
+    chain = {s["name"] for s in spans if carries(s)}
+    assert {"firehose.ingest", "firehose.aggregate", "sched.dispatch",
+            "firehose.resolve"}.issubset(chain)
+    # ...and the chain genuinely crosses the producer/flusher boundary
+    assert len({(s["thread"], s["thread_id"])
+                for s in spans if carries(s)}) >= 2
+
+    # the same reconstruction from the persisted artifact: span dump →
+    # chrome trace, flow chain present for the sampled trace id
+    dump_path = tmp_path / "spans.json"
+    obs_timeline.write_span_dump(dump_path, spans)
+    loaded = obs_timeline.load_span_dump(dump_path.read_text())
+    out = obs_timeline.chrome_trace(loaded)
+    flows = [e for e in out["traceEvents"]
+             if e.get("cat") == "request" and e["id"] == tid]
+    assert len(flows) >= 2
+    assert len({e["tid"] for e in flows}) >= 2
+
+
+def test_failed_collapse_fan_out_names_exact_reverify_set():
+    """Committee 1's bad member poisons its collapsed check; the
+    sched.reverify span's links must name EXACTLY the member requests of
+    that collapsed entry — the fan-out side of the causality contract."""
+    tracer = obs_trace.Tracer(registry=MetricsRegistry(),
+                              max_spans=65536).install()
+    try:
+        good = [_payload(0, [0]), _payload(0, [1])]
+        poisoned = [_payload(1, [2]), _payload(1, [3], good=False),
+                    _payload(1, [2, 3])]
+        fh, reg = _firehose(threaded=False)
+        fh.offer_many(good + poisoned)
+        fh.drain()
+        spans = tracer.spans()
+    finally:
+        tracer.uninstall()
+    assert reg.counter_value("sched_collapse_reverify_total",
+                             work_class="bls") >= 1
+
+    # map payload → trace id via ingest order (offer_many is sequential
+    # in inline mode, and ids mint in ingest order)
+    ingest_spans = [s for s in spans if s["name"] == "firehose.ingest"]
+    assert len(ingest_spans) == len(good) + len(poisoned)
+    expected = {s["trace_id"] for s in ingest_spans[len(good):]}
+    assert len(expected) == len(poisoned)
+
+    reverifies = [s for s in spans if s["name"] == "sched.reverify"]
+    assert len(reverifies) == 1
+    got = {li["trace_id"] for li in reverifies[0]["links"]}
+    assert got == expected
+    assert reverifies[0]["attrs"]["members"] == len(poisoned)
+
+
+# --- the black-box reconciliation: chaos mid-flush ---------------------------
+
+
+def test_breaker_open_mid_flush_black_box_reconciles_with_plan(
+        _isolated_obs):
+    """Threaded chaos: a seeded fault schedule exhausts the flush retry
+    budget mid-stream, the kill feeds a failure_threshold=1 breaker (the
+    bridge convention), and the breaker-open trigger produces EXACTLY one
+    black box whose ring holds the triggering fault site with multiplicity
+    == plan.fires(site)."""
+    site = "firehose.flush"
+    plan = FaultPlan(seed=5, sites={
+        site: FaultSpec(kind="raise", at_calls=(1, 2, 3, 4), exc="xla"),
+    })
+    brk = CircuitBreaker(failure_threshold=1, name="flush-device")
+    fh, reg = _firehose(threaded=True, batch_attestations=2)
+    with plan.active():
+        fh.start()
+        fh.offer_many([_payload(0, [0]), _payload(0, [1])])
+        deadline = time.time() + 10.0
+        while fh.failure is None and time.time() < deadline:
+            time.sleep(0.01)
+        assert fh.failure is not None
+        brk.record_failure()  # the epoch-path convention: kill → breaker
+
+    opens = [d for d in _isolated_obs.dumps if d["trigger"] == "breaker_open"]
+    assert len(opens) == 1
+    ring_fires = [e for e in opens[0]["events"]
+                  if e["kind"] == "fault" and e["site"] == site]
+    assert plan.fires(site) == 4
+    assert len(ring_fires) == plan.fires(site)
+    assert [e["call"] for e in ring_fires] == [1, 2, 3, 4]
+    # the kill itself black-boxed too (the FirehoseKilled trigger)
+    kills = [d for d in _isolated_obs.dumps
+             if d["trigger"] == "firehose_killed"]
+    assert len(kills) == 1
+    fh.stop(drain=False)
+
+
+# --- SLO gate ----------------------------------------------------------------
+
+
+def test_slo_spec_loads_and_passes_on_shipped_evidence():
+    specs = obs_slo.load_spec_file(REPO / "slo.json")
+    assert {s.name for s in specs} >= {
+        "firehose_steady_throughput_floor", "firehose_p99_ingest_to_verified",
+        "sched_occupancy_min", "firehose_zero_drops_on_bench",
+        "disabled_tracer_overhead"}
+    with open(REPO / "BENCH_OBS.json") as f:
+        snap = json.load(f)
+    with open(REPO / "BENCH_LOCAL.json") as f:
+        bench = json.load(f)
+    results = obs_slo.evaluate(specs, [snap], bench)
+    summary = obs_slo.summarize(results)
+    assert summary["fail"] == 0, summary["violations"]
+
+
+def test_slo_evaluate_policies():
+    specs = obs_slo.load_spec({"version": 1, "slos": [
+        {"name": "drops", "source": "obs", "kind": "counter",
+         "series": "dropped_total", "op": "<=", "value": 0,
+         "lanes": ["bench"]},
+        {"name": "lat", "source": "obs", "kind": "histogram",
+         "series": "lat_seconds", "stat": "p99", "op": "<=", "value": 1.0},
+        {"name": "gone", "source": "bench", "path": "extra.nope",
+         "op": ">=", "value": 1, "missing": "pass"},
+        {"name": "gone_hard", "source": "bench", "path": "extra.nope",
+         "op": ">=", "value": 1, "missing": "fail"},
+    ]})
+    chaos_snap = {"version": 1, "meta": {"lane": "chaos"},
+                  "counters": {"dropped_total": 7.0}, "gauges": {},
+                  "histograms": {}}
+    bench_snap = {"version": 1, "meta": {"lane": "bench"},
+                  "counters": {"dropped_total": 0.0}, "gauges": {},
+                  "histograms": {"lat_seconds": {
+                      "count": 10, "sum": 2.0, "p50": 0.1, "p99": 0.4,
+                      "min": 0.0, "max": 0.5}}}
+    results = {r.name: r for r in obs_slo.evaluate(
+        specs, [chaos_snap, bench_snap], [])}
+    assert results["drops"].ok          # chaos lane out of scope
+    assert results["lat"].ok
+    assert results["gone"].ok           # missing=pass
+    assert not results["gone_hard"].ok  # missing=fail
+
+
+def test_slo_violation_reports_worst_offender():
+    specs = obs_slo.load_spec({"version": 1, "slos": [
+        {"name": "drops", "source": "obs", "kind": "counter",
+         "series": "dropped_total", "op": "<=", "value": 0}]})
+    bad = {"version": 1, "meta": {"lane": "bench"},
+           "counters": {"dropped_total": 7.0}, "gauges": {},
+           "histograms": {}}
+    (r,) = obs_slo.evaluate(specs, [bad], [])
+    assert not r.ok and r.measured == 7.0 and "violates" in r.detail
+
+
+def test_compile_per_shape_reconciliation():
+    specs = obs_slo.load_spec({"version": 1, "slos": [
+        {"name": "one_compile", "source": "obs",
+         "kind": "compile_per_shape", "op": "<=", "value": 0}]})
+    clean = {"version": 1, "meta": {},
+             "counters": {"compile_total{kernel=bls}": 3.0},
+             "gauges": {"compile_distinct_shapes{kernel=bls}": 3.0},
+             "histograms": {}}
+    dirty = {"version": 1, "meta": {},
+             "counters": {"compile_total{kernel=bls}": 14.0},
+             "gauges": {"compile_distinct_shapes{kernel=bls}": 3.0},
+             "histograms": {}}
+    (ok,) = obs_slo.evaluate(specs, [clean], [])
+    (bad,) = obs_slo.evaluate(specs, [dirty], [])
+    assert ok.ok
+    assert not bad.ok and bad.measured == 11.0
+
+
+def _run(args, **kw):
+    return subprocess.run([sys.executable, *args], capture_output=True,
+                          text=True, cwd=REPO, timeout=120, **kw)
+
+
+def test_slo_check_cli_green_on_shipped_red_on_doctored(tmp_path):
+    r = _run(["tools/slo_check.py"])
+    assert r.returncode == 0, r.stderr
+    assert "0 fail" in r.stdout
+    # doctor a bench-lane snapshot that sheds load: the zero-drops SLO
+    # must fail BY NAME with rc != 0
+    doctored = {"version": 1, "meta": {"lane": "bench"},
+                "counters": {"firehose_dropped_total": 7.0}, "gauges": {},
+                "histograms": {}}
+    path = tmp_path / "obs_doctored.json"
+    path.write_text(obs_export.canonical_json(doctored))
+    r = _run(["tools/slo_check.py", str(path)])
+    assert r.returncode == 1
+    assert "SLO VIOLATION firehose_zero_drops_on_bench" in r.stderr
+
+
+def test_slo_check_cli_rejects_unreadable_snapshot(tmp_path):
+    bad = tmp_path / "obs_bad.json"
+    bad.write_text("{not json")
+    r = _run(["tools/slo_check.py", str(bad)])
+    assert r.returncode == 2
+
+
+def test_obs_dump_trace_cli(tmp_path):
+    spans = _synthetic_spans()
+    dump = tmp_path / "spans.json"
+    obs_timeline.write_span_dump(dump, spans)
+    out = tmp_path / "trace.json"
+    r = _run(["tools/obs_dump.py", "trace", str(dump), "-o", str(out)])
+    assert r.returncode == 0, r.stderr
+    trace = json.loads(out.read_text())
+    assert {e["ph"] for e in trace["traceEvents"]} >= {"M", "X", "s"}
+    # stdout mode emits the same canonical bytes
+    r2 = _run(["tools/obs_dump.py", "trace", str(dump)])
+    assert r2.returncode == 0
+    assert r2.stdout == out.read_text()
+    # a metrics snapshot is NOT a span dump: rc 1, loud
+    notspans = tmp_path / "obs.json"
+    notspans.write_text(obs_export.canonical_json(
+        {"version": 1, "counters": {}, "gauges": {}, "histograms": {}}))
+    r3 = _run(["tools/obs_dump.py", "trace", str(notspans)])
+    assert r3.returncode == 1
+    assert "INVALID span dump" in r3.stderr
+
+
+def test_disabled_overhead_measurement_refuses_live_tracer():
+    tracer = obs_trace.Tracer(registry=MetricsRegistry()).install()
+    try:
+        with pytest.raises(RuntimeError):
+            obs_slo.measure_disabled_span_ns(number=10)
+    finally:
+        tracer.uninstall()
+    assert obs_slo.measure_disabled_span_ns(number=1000) < 1e5

@@ -166,10 +166,15 @@ def test_seam_coverage_fixture():
     """PR-6 guarantee, statically: a FaultPlan seam fired outside any
     obs.trace.span() scope is an error, as is a non-constant site label;
     direct spans, caller-side spans, and the resident nested-attempt
-    pattern are all recognized as covered."""
+    pattern are all recognized as covered — including the ISSUE-13
+    context-propagation shape (span(..., ctx=ctx, links=links)), where
+    minting a TraceContext or assembling links does NOT substitute for
+    opening the span."""
     expected = _fixture_matches_annotations(FIXTURES / "seam_pkg")
     assert {r for _, r in expected} == {"seam-coverage"}
-    assert len(expected) == 2  # naked call site; computed site label
+    # naked call site; computed site label; mint-without-span (firehose);
+    # link-assembly-without-span (sched)
+    assert len(expected) == 4
 
 
 def test_seam_counter_fixture():

@@ -1,0 +1,103 @@
+"""Declarative SLO gate CLI (`make slo`, CI).
+
+Evaluates the repo-root slo.json (or `--spec FILE`) against:
+
+  * obs snapshots given as positional args (default: BENCH_OBS.json) —
+    each is validated as a canonical snapshot first, so a corrupted
+    artifact fails the gate rather than silently passing "missing";
+  * bench history from `--bench` (default: BENCH_LOCAL.json) — missing
+    file is an empty history, the per-spec `missing` policy decides;
+  * the disabled-tracer overhead, measured inline.
+
+Exit codes: 0 all SLOs hold, 1 at least one violated (each printed to
+stderr as `SLO VIOLATION <name>: <detail>`), 2 spec or snapshot
+unreadable. Pass `-v` to print the full pass/fail table either way.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from consensus_specs_tpu.obs import export as obs_export  # noqa: E402
+from consensus_specs_tpu.obs import slo as obs_slo  # noqa: E402
+
+
+def _load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    ok, reason = obs_export.validate_snapshot_text(text)
+    if not ok:
+        raise ValueError(f"invalid snapshot: {reason}")
+    return json.loads(text)
+
+
+def _load_bench(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        history = json.load(f)
+    if not isinstance(history, list):
+        raise ValueError("bench history is not a JSON list")
+    return history
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("snapshots", nargs="*",
+                        default=[os.path.join(REPO_ROOT, "BENCH_OBS.json")],
+                        help="obs snapshot paths (default: BENCH_OBS.json)")
+    parser.add_argument("--spec",
+                        default=os.path.join(REPO_ROOT, "slo.json"),
+                        help="SLO spec file (default: repo-root slo.json)")
+    parser.add_argument("--bench",
+                        default=os.path.join(REPO_ROOT, "BENCH_LOCAL.json"),
+                        help="bench history (default: BENCH_LOCAL.json)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="print every SLO's verdict, not just violations")
+    args = parser.parse_args(argv)
+
+    try:
+        specs = obs_slo.load_spec_file(args.spec)
+    except (OSError, ValueError, TypeError, json.JSONDecodeError) as exc:
+        print(f"slo-check: cannot load spec {args.spec}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    snapshots = []
+    for path in args.snapshots:
+        try:
+            snapshots.append(_load_snapshot(path))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"slo-check: cannot load snapshot {path}: {exc}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        bench_records = _load_bench(args.bench)
+    except (ValueError, json.JSONDecodeError) as exc:
+        print(f"slo-check: cannot load bench history {args.bench}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    results = obs_slo.evaluate(specs, snapshots, bench_records)
+    summary = obs_slo.summarize(results)
+
+    for r in results:
+        if not r.ok:
+            print(f"SLO VIOLATION {r.name}: {r.detail}", file=sys.stderr)
+        elif args.verbose:
+            print(f"slo ok    {r.name}: {r.detail}")
+
+    print(f"slo-check: {summary['pass']} pass, {summary['fail']} fail "
+          f"({len(snapshots)} snapshot(s), {len(bench_records)} bench "
+          f"record(s))")
+    return 1 if summary["fail"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
