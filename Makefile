@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched msm firehose scenarios slo lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched msm firehose scenarios proofs slo lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -107,6 +107,19 @@ scenarios:
 	    tests/test_scenarios.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_scenarios.json
 
+# Light-client read lane: device-batched Merkle multiproofs (ops +
+# engine + the sched "multiproof" kind) pinned against the ssz host
+# oracle, plus the dirty-column proof cache and its service — see README
+# "Read path". Obs snapshot validated like the chaos/sched/firehose
+# lanes; the proof_* series are the artifact.
+proofs:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_proofs.json OBS_SNAPSHOT_LANE=proofs \
+	OBS_FLIGHT_DIR=test-results \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_proofs.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_proofs.json
+
 # Declarative SLO gate (slo.json at the repo root): the bench trajectory
 # and obs-snapshot invariants as machine-checked objectives — see README
 # "Observability" and the SLO table in BASELINE.md. Evaluates the shipped
@@ -201,7 +214,8 @@ bench:
 bench_quick:
 	BENCH_BLS_N=512 BENCH_E2E_RESIDENT_EPOCHS=6 BENCH_KZG_BLOBS=32 \
 	BENCH_ATT_VALIDATORS=32768 BENCH_SR_VALIDATORS=262144 \
-	BENCH_E2E_VALIDATORS=1048576 $(PYTHON) bench.py
+	BENCH_E2E_VALIDATORS=1048576 BENCH_PROOF_VALIDATORS=1048576 \
+	BENCH_PROOF_QUERIES=2048 $(PYTHON) bench.py
 
 # TPU-opportunistic bench loop: retry the probe until the tunnel answers,
 # then run the bench_quick lane on the device; every attempt (success or

@@ -236,6 +236,10 @@ def run_benches() -> dict:
             import benches.scenario_bench as scenario_bench
 
             scen_r = scenario_bench.run()
+        with timed("bench_proofs"):
+            import benches.proof_bench as proof_bench
+
+            proof_r = proof_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -348,6 +352,18 @@ def run_benches() -> dict:
             "scenario_vectors_diffed": scen_r["scenario_vectors_diffed"],
             "scenario_slots": scen_r["scenario_slots"],
             "scenario_faults_fired": scen_r["scenario_faults_fired"],
+            # light-client read lane: batched device multiproofs + the
+            # dirty-column proof cache serving thousands of branch queries
+            # while the epoch+firehose write path runs; p99 from the
+            # lane's own histogram and the cross-checked device-vs-host
+            # speedup on identical inputs
+            "proof_proofs_per_s_cold": proof_r["proof_proofs_per_s_cold"],
+            "proof_proofs_per_s_warm": proof_r["proof_proofs_per_s_warm"],
+            "proof_cache_hit_ratio": proof_r["proof_cache_hit_ratio"],
+            "proof_p99_request_s": proof_r["proof_p99_request_s"],
+            "proof_vs_host_speedup": proof_r["proof_vs_host_speedup"],
+            "proof_queries": proof_r["proof_queries"],
+            "proof_write_epochs": proof_r["proof_write_epochs"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],
@@ -427,6 +443,11 @@ def main() -> None:
         # sync-aggregate stream: fewer blocks (host signing + the pairing
         # compile dominate on CPU; the per-block rate is what's measured)
         os.environ.setdefault("BENCH_SYNC_BLOCKS", "8")
+        # proof read lane: smaller registry + query set (the epoch write
+        # path stepping underneath is the expensive part on CPU; the
+        # proofs/s and hit-ratio shape is what's measured)
+        os.environ.setdefault("BENCH_PROOF_VALIDATORS", "65536")
+        os.environ.setdefault("BENCH_PROOF_QUERIES", "1024")
     try:
         record = run_benches()
         if N_VALIDATORS >= 1_048_576:

@@ -372,6 +372,13 @@ class ResidentEpochEngine:
         )
         bridge.install_next_sync_committee(spec, state, active, eff, bytes(seed))
 
+    def dirty_columns(self) -> dict:
+        """{tracked column name: moved since the last materialize} — the
+        accumulated dirty-column diff. Read-only: the proof cache's epoch
+        advance (proofs/cache.py) consumes this shape; materialize() still
+        owns the reset."""
+        return {name: bool(f) for name, f in zip(DIRTY_TRACKED, self._dirty)}
+
     def materialize(self) -> dict:
         """Sync the host `BeaconState` to the device state: the one
         write-back, identical in effect to the per-epoch write-back of the

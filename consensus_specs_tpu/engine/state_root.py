@@ -132,6 +132,26 @@ def _tree_root_batch_impl(chunks: jax.Array) -> jax.Array:
 tree_root_batch = jax.jit(_tree_root_batch_impl)
 
 
+def multiproof_batch(chunk_words, tree_ids, gindices):
+    """Host entry for the batched multiproof kernel: numpy in, numpy out.
+
+    chunk_words (K, C, 8) uint32 with C a power of two (K and Q are the
+    caller's pow2 buckets); tree_ids/gindices select (tree, node) per
+    query. Returns (siblings (Q, D, 8), nodes (Q, 8), roots (K, 8)) as
+    host arrays; a query at depth d uses siblings[:d] (deepest first,
+    `ssz/proofs.build_proof` order). One XLA compile per (K, C, Q) shape
+    triple — the scheduler's Merkle work class owns the bucketing."""
+    from ..ops.multiproof_jax import sibling_rows_batch
+
+    sib, nodes, roots = sibling_rows_batch(
+        jnp.asarray(chunk_words, dtype=U32),
+        jnp.asarray(tree_ids, dtype=jnp.int32),
+        jnp.asarray(gindices, dtype=jnp.int32))
+    return (np.asarray(jax.device_get(sib)),
+            np.asarray(jax.device_get(nodes)),
+            np.asarray(jax.device_get(roots)))
+
+
 def _extend(root: jax.Array, from_depth: int, to_depth: int) -> jax.Array:
     """Fold the root up to `to_depth` against zero-subtree roots."""
     zw = jnp.asarray(_ZERO_WORDS)

@@ -1,0 +1,74 @@
+"""Batched Merkle multiproof extraction as a JAX kernel (the read lane).
+
+For a pow2-bucketed batch of (tree, gindex) queries over equal-shape chunk
+trees, ONE jitted program hashes every interior level once — the same flat
+adjacent-pair fold as `engine/state_root.tree_root_batch`, so queries that
+hit the same subtree share its interior-node hashing by construction — and
+then gathers each query's sibling rows with a gather-form level walk: no
+scatter, int32-pinned `fori_loop` bounds (the tpulint dtype-pin rule:
+under x64 an unpinned induction var is s64 while GSPMD emits s32 offset
+math for the dynamic slices, failing HLO verification on sharded
+programs).
+
+Layout: the level stack concatenates into a per-tree binary heap addressed
+by generalized index (heap[:, 1] = root, heap[:, C:2C] = the leaf chunks;
+heap[:, 0] is a zero row, so a query shallower than the batch depth
+gathers zeros past its own branch, which the host slices off). Sibling row
+i of a query is the sibling of its node at distance i above it — exactly
+`ssz/proofs.build_proof` order (deepest first), so host and device
+branches compare byte-for-byte.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .sha256_jax import merkle_parent_level
+
+
+def _sibling_rows_impl(chunks: jax.Array, tree_ids: jax.Array,
+                       gindices: jax.Array):
+    """(K, C, 8) uint32 chunk words (C a power of two), (Q,) int32 tree
+    slots, (Q,) int32 in-tree generalized indices ->
+    (siblings (Q, D, 8), nodes (Q, 8), roots (K, 8)) with D = max(depth, 1).
+
+    A depth-d query fills siblings[:d]; rows beyond gather the zero heap
+    row. `nodes` is each query's own node (leaf chunk or subtree root), so
+    callers can verify branches without re-deriving the leaf."""
+    k, c, _ = chunks.shape
+    assert c & (c - 1) == 0, "per-tree chunk count must be a power of two"
+    depth = (c - 1).bit_length() if c > 1 else 0
+    q = gindices.shape[0]
+
+    levels = [chunks.reshape(k * c, 8)]
+    for _ in range(depth):
+        levels.append(merkle_parent_level(levels[-1]))
+    roots = levels[-1].reshape(k, 8)
+
+    # per-tree heap addressed by generalized index: row 0 zero, row 1 the
+    # root, rows [C, 2C) the leaves — pure concatenation, no scatter
+    zero_row = jnp.zeros((k, 1, 8), dtype=chunks.dtype)
+    heap = jnp.concatenate(
+        [zero_row] + [lvl.reshape(k, -1, 8) for lvl in reversed(levels)],
+        axis=1)
+    flat = heap.reshape(k * 2 * c, 8)
+
+    base = tree_ids * jnp.int32(2 * c)
+    nodes = jnp.take(flat, base + gindices, axis=0)
+    out0 = jnp.zeros((q, max(depth, 1), 8), dtype=chunks.dtype)
+
+    def step(i, carry):
+        g, out = carry
+        rows = jnp.take(flat, base + (g ^ jnp.int32(1)), axis=0)
+        out = jax.lax.dynamic_update_index_in_dim(out, rows, i, axis=1)
+        # clamp at the root: a finished (shallower) query's next sibling is
+        # root ^ 1 = the zero row, never a wrapped heap read
+        return jnp.maximum(g >> jnp.int32(1), jnp.int32(1)), out
+
+    # int32 loop bounds: the dtype-pin rule (see ops/sha256_jax._compress)
+    _, siblings = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(depth), step, (gindices, out0))
+    return siblings, nodes, roots
+
+
+sibling_rows_batch = jax.jit(_sibling_rows_impl)

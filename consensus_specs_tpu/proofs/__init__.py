@@ -1,0 +1,10 @@
+"""Light-client read lane: epoch-versioned proof cache + serving front end.
+
+jax-free at module level by charter (tpulint import-layering): device work
+reaches the multiproof kernel only through sched "multiproof" submits, so
+shims and tools can import the cache without dragging the device stack in.
+"""
+from .cache import ProofCache
+from .service import ProofService, leaf_gindex, u64_column_chunks
+
+__all__ = ["ProofCache", "ProofService", "leaf_gindex", "u64_column_chunks"]

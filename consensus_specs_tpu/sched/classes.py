@@ -240,16 +240,48 @@ class KzgWorkClass(WorkClass):
 
 
 class MerkleWorkClass(WorkClass):
-    """Batched SSZ chunk-tree roots: kind "tree_root", payload = (chunks,)
-    with chunks a sequence of 32-byte leaves. Trees sharing a leaf count
-    fold in one `engine/state_root.tree_root_batch` launch, padded to the
-    pow2 tree bucket with zero trees (results discarded); the host
-    fallback is the ssz merkleize oracle."""
+    """Batched SSZ chunk-tree lanes. Two kinds, both over 32-byte leaves:
+
+    - "tree_root": payload = (chunks,). Trees sharing a leaf count fold in
+      one `engine/state_root.tree_root_batch` launch, padded to the pow2
+      tree bucket with zero trees (results discarded); host fallback is
+      the ssz merkleize oracle.
+    - "multiproof": payload = (chunks, gindex) with gindex a generalized
+      index over the pow2-padded chunk tree (1 = root, C..2C-1 = leaves).
+      Queries sharing a leaf-count bucket fold in one
+      `engine/state_root.multiproof_batch` launch; identical trees within
+      the batch share ONE device slot (interior hashing paid once), the
+      tree axis pads with zero trees and the query axis with root queries
+      against tree 0 (both discarded). The result row is the deepest-first
+      sibling branch as a tuple of 32-byte values; host fallback is the
+      `ssz/proofs.build_chunk_proof` oracle, bit-identical by
+      construction.
+
+    A pure tree_root batch keeps the legacy (n, 32) uint8 result array;
+    any batch containing a multiproof returns object dtype — branch tuples
+    alongside (32,) uint8 root rows (the msm marker-tuple precedent, which
+    the scheduler's row validation accepts)."""
 
     name = "merkle"
-    kinds = ("tree_root",)
+    kinds = ("tree_root", "multiproof")
 
     def execute(self, requests: list) -> np.ndarray:
+        if all(r.kind == "tree_root" for r in requests):
+            return self._tree_roots_device(requests)
+        out = np.empty(len(requests), dtype=object)
+        root_idxs = [i for i, r in enumerate(requests)
+                     if r.kind == "tree_root"]
+        if root_idxs:
+            rows = self._tree_roots_device([requests[i] for i in root_idxs])
+            for row, i in zip(rows, root_idxs):
+                out[i] = row
+        self._multiproofs_device(
+            requests,
+            [i for i, r in enumerate(requests) if r.kind == "multiproof"],
+            out)
+        return out
+
+    def _tree_roots_device(self, requests: list) -> np.ndarray:
         import jax
         import jax.numpy as jnp
 
@@ -277,24 +309,100 @@ class MerkleWorkClass(WorkClass):
                     words_to_bytes(roots[row]), dtype=np.uint8)
         return np.asarray(out, dtype=np.uint8)
 
+    def _multiproofs_device(self, requests: list, idxs: list,
+                            out: np.ndarray) -> None:
+        """Fill out[i] (a branch tuple) for every multiproof index."""
+        from ..engine import state_root as SR
+        from ..ops.sha256_jax import words_to_bytes
+
+        by_shape: dict = {}
+        for i in idxs:
+            chunks, gindex = requests[i].payload
+            c_full = bucketing.pow2_bucket(max(1, len(chunks)), 1)
+            depth = (c_full - 1).bit_length() if c_full > 1 else 0
+            g = int(gindex)
+            if g < 1 or g.bit_length() - 1 > depth:
+                raise ValueError(
+                    f"multiproof gindex {g} outside the depth-{depth} "
+                    f"padded chunk tree")
+            by_shape.setdefault(c_full, []).append((i, g))
+        # content keys memoized by payload identity: a proof-service flush
+        # reuses ONE chunks tuple for a whole column's queries, so the
+        # O(leaf-count) key build must run once per distinct tuple, not
+        # once per request (the payloads stay alive in `requests`, so ids
+        # cannot be recycled underneath the memo)
+        content_keys: dict = {}
+
+        def key_for(chunks) -> tuple:
+            key = content_keys.get(id(chunks))
+            if key is None:
+                key = content_keys[id(chunks)] = tuple(
+                    bytes(c) for c in chunks)
+            return key
+
+        for c_full, members in sorted(by_shape.items()):
+            slots: dict = {}
+            queries = []
+            for i, g in members:
+                key = key_for(requests[i].payload[0])
+                slot = slots.get(key)
+                if slot is None:
+                    slot = slots[key] = len(slots)
+                queries.append((i, slot, g))
+            b_k = bucketing.pow2_bucket(len(slots), 1)
+            b_q = bucketing.pow2_bucket(len(queries), 1)
+            words = np.zeros((b_k, c_full, 8), dtype=np.uint32)
+            for key, slot in slots.items():
+                for j, leaf in enumerate(key):
+                    words[slot, j] = np.frombuffer(
+                        leaf, dtype=">u4").astype(np.uint32)
+            tree_ids = np.zeros(b_q, dtype=np.int32)
+            gidx = np.ones(b_q, dtype=np.int32)  # pad: root query on tree 0
+            for row, (i, slot, g) in enumerate(queries):
+                tree_ids[row] = slot
+                gidx[row] = g
+            sib, _nodes, _roots = SR.multiproof_batch(words, tree_ids, gidx)
+            for row, (i, slot, g) in enumerate(queries):
+                d = g.bit_length() - 1
+                out[i] = tuple(
+                    words_to_bytes(sib[row, lvl]) for lvl in range(d))
+
     def execute_degraded(self, requests: list) -> np.ndarray:
         from ..ssz.merkle import merkleize_chunks
 
-        return np.asarray(
-            [np.frombuffer(
-                merkleize_chunks([bytes(c) for c in r.payload[0]]),
-                dtype=np.uint8)
-             for r in requests], dtype=np.uint8)
+        if all(r.kind == "tree_root" for r in requests):
+            return np.asarray(
+                [np.frombuffer(
+                    merkleize_chunks([bytes(c) for c in r.payload[0]]),
+                    dtype=np.uint8)
+                 for r in requests], dtype=np.uint8)
+        from ..ssz.proofs import build_chunk_proof
+
+        out = np.empty(len(requests), dtype=object)
+        for i, r in enumerate(requests):
+            if r.kind == "tree_root":
+                out[i] = np.frombuffer(
+                    merkleize_chunks([bytes(c) for c in r.payload[0]]),
+                    dtype=np.uint8)
+            else:
+                chunks, gindex = r.payload
+                out[i] = tuple(build_chunk_proof(
+                    [bytes(c) for c in chunks], int(gindex)))
+        return out
 
     def to_result(self, row):
+        if isinstance(row, tuple):
+            return row  # multiproof branch: deepest-first 32-byte siblings
         return np.asarray(row, dtype=np.uint8).tobytes()
 
     def load(self, requests: list) -> tuple:
-        # units are whole trees; each leaf-count bucket pads independently
+        # units are whole trees (tree_root) / queries (multiproof); each
+        # (kind, leaf-count) bucket pads independently
         by_shape: dict = {}
         for r in requests:
             c_full = bucketing.pow2_bucket(max(1, len(r.payload[0])), 1)
-            by_shape[c_full] = by_shape.get(c_full, 0) + 1
+            key = (r.kind, c_full)
+            by_shape[key] = by_shape.get(key, 0) + 1
         live = len(requests)
         padded = sum(bucketing.pow2_bucket(k, 1) for k in by_shape.values())
         return live, padded

@@ -21,8 +21,10 @@ from .gindex import (  # noqa: F401
     get_generalized_index_length,
 )
 from .proofs import (  # noqa: F401
+    build_chunk_proof,
     build_multiproof,
     build_proof,
+    build_proofs,
     calculate_multi_merkle_root,
     get_helper_indices,
     get_subtree_node_root,

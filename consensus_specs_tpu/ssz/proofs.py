@@ -121,13 +121,12 @@ def node_deref(node):
     return node
 
 
-def build_proof(value, gindex: int) -> list[bytes]:
-    """Sibling hashes for `gindex`, ordered leaf-level first (ready for
-    is_valid_merkle_branch / light-client update verification)."""
+def _branch_for(tree, gindex: int) -> list[bytes]:
+    """Sibling walk over an already-expanded node tree, deepest first."""
     if gindex < 1:
         raise ValueError("generalized index must be >= 1")
     bits = [(gindex >> i) & 1 for i in range(gindex.bit_length() - 2, -1, -1)]
-    node = to_node(value)
+    node = tree
     proof: list[bytes] = []
     for b in bits:
         node = node_deref(node)
@@ -135,6 +134,33 @@ def build_proof(value, gindex: int) -> list[bytes]:
         proof.append(node_root(sibling))
         node = node_child(node, bool(b))
     return list(reversed(proof))
+
+
+def build_proof(value, gindex: int) -> list[bytes]:
+    """Sibling hashes for `gindex`, ordered leaf-level first (ready for
+    is_valid_merkle_branch / light-client update verification)."""
+    return _branch_for(to_node(value), gindex)
+
+
+def build_proofs(value, gindices) -> list[list[bytes]]:
+    """Multi-query host entry: one branch per gindex, in input order, all
+    walked over ONE shared `to_node` expansion (build_proof re-expands the
+    typed value per call). Unlike build_multiproof's helper-set form, the
+    branches are independent — duplicate or ancestor/descendant gindices
+    are fine — so this is the oracle shape the device multiproof kernel
+    pins against."""
+    tree = to_node(value)
+    return [_branch_for(tree, g) for g in gindices]
+
+
+def build_chunk_proof(chunks, gindex: int) -> list[bytes]:
+    """Branch for `gindex` over a raw 32-byte chunk list merkleized into
+    its pow2-padded tree (merkleize_chunks semantics: zero-chunk padding,
+    no length mix-in) — the host oracle and sched fallback for the device
+    multiproof kernel, which serves exactly such chunk trees (registry
+    columns)."""
+    leaves = [_leaf(bytes(c)) for c in chunks]
+    return _branch_for(_sub(leaves, _height_for(len(leaves))), gindex)
 
 
 def _node_root_at(node, gindex: int) -> bytes:

@@ -1,0 +1,8 @@
+"""Seeded failure shape: a proof-cache module importing the device stack
+at module level — every jax-free consumer (tools, shims, the obs dump)
+would drag jax in just by reading cached branches."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def lookup(column, gindex):
+    return jax.device_get((column, gindex))

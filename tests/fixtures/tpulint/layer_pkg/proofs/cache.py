@@ -1,0 +1,18 @@
+"""Clean proof-cache module: jax-free at module level, the device path
+deferred into the serving body — the proofs/ charter (cache lookups and
+dirty-column invalidation never touch the device stack; only a miss pays
+for the multiproof kernel)."""
+
+entries = {}
+
+
+def lookup(column, gindex):
+    return entries.get((column, gindex))
+
+
+def prove(column, gindex, chunks, use_device=False):
+    if use_device:
+        import jax  # deferred: only the miss path pays
+
+        return jax.device_get(chunks)
+    return list(chunks)

@@ -27,3 +27,15 @@ def horner_combine(acc, n_windows):
         return a + jnp.int32(i)
 
     return jax.lax.fori_loop(jnp.int32(0), n_windows - 1, body, acc)  # tpulint-expect: dtype-pin
+
+
+def level_walk(gindices, siblings, depth):
+    """The multiproof level-walk shape (PR 15) with the bad spelling: both
+    bounds bare, so the induction var driving the dynamic_update_index
+    traces s64 against the s32 gindex carry."""
+    def step(i, carry):
+        g, out = carry
+        out = jax.lax.dynamic_update_index_in_dim(out, g, i, axis=1)
+        return g >> jnp.int32(1), out
+
+    return jax.lax.fori_loop(0, depth, step, (gindices, siblings))  # tpulint-expect: dtype-pin

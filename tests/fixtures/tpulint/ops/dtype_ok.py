@@ -33,3 +33,15 @@ def horner_combine(acc, n_windows):
         return a + jnp.int32(i)
 
     return jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_windows - 1), body, acc)
+
+
+def level_walk(gindices, siblings, depth):
+    """The sanctioned multiproof level-walk spelling
+    (ops/multiproof_jax._sibling_rows_impl): both bounds pinned int32."""
+    def step(i, carry):
+        g, out = carry
+        out = jax.lax.dynamic_update_index_in_dim(out, g, i, axis=1)
+        return g >> jnp.int32(1), out
+
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(depth), step,
+                             (gindices, siblings))

@@ -487,6 +487,45 @@ def test_obs_dump_table_groups_by_subsystem_prefix(tmp_path):
     assert all(ln.startswith("  ") for ln in body)
 
 
+def test_obs_dump_table_groups_proof_series(tmp_path):
+    """The read lane's proof_* series (PR 15 cache + service) group under
+    one [proof] header with counter -> gauge -> histogram ordering — the
+    prefix grouping must keep absorbing new subsystems with no renderer
+    change."""
+    r = MetricsRegistry()
+    r.counter("proof_requests_total").inc(12)
+    r.counter("proof_cache_hits_total", column="balances").inc(8)
+    r.counter("proof_cache_misses_total", column="balances").inc(4)
+    r.counter("proof_cache_invalidated_total", column="balances").inc(2)
+    r.gauge("proof_cache_hit_ratio").set(8 / 12)
+    r.gauge("proof_cache_entries").set(6)
+    r.histogram("proof_request_latency_seconds").observe(0.002)
+    r.counter("sched_submitted_total", work_class="merkle",
+              kind="multiproof").inc(4)
+    path = tmp_path / "snap.json"
+    obs_export.write_snapshot(path, r, meta={"lane": "proofs"})
+    res = _run_dump("table", str(path))
+    assert res.returncode == 0, res.stderr
+    lines = res.stdout.splitlines()
+    headers = [ln for ln in lines if ln.startswith("[")]
+    assert headers == ["[proof]", "[sched]"]
+    start = lines.index("[proof]") + 1
+    block = []
+    for ln in lines[start:]:
+        if not ln.startswith("  "):
+            break
+        block.append(ln.split()[0])
+    assert block == [
+        'proof_cache_hits_total{column="balances"}',
+        'proof_cache_invalidated_total{column="balances"}',
+        'proof_cache_misses_total{column="balances"}',
+        "proof_requests_total",
+        "proof_cache_entries",
+        "proof_cache_hit_ratio",
+        "proof_request_latency_seconds",
+    ]
+
+
 def test_obs_dump_table_top_ranks_hottest_first(tmp_path):
     """--top N drops the grouping: counters/gauges ranked by value,
     histograms by p99, truncated to N each — the incident view."""

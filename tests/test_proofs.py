@@ -1,0 +1,441 @@
+"""The light-client read lane (PR 15): device-batched Merkle multiproofs
+pinned bit-identical against the ssz host oracle, the "multiproof" sched
+kind's padding/dedup/chaos behaviour, and the dirty-column proof cache's
+correctness under real epoch mutation.
+
+Layers under test:
+  * ssz/proofs.py  — build_proofs / build_chunk_proof host oracles
+  * ops/multiproof_jax.py + engine/state_root.multiproof_batch — kernel
+  * sched/classes.py MerkleWorkClass kind="multiproof" — batching seam
+  * proofs/ — ProofCache + ProofService (epoch-versioned invalidation)
+"""
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.obs import metrics as obs_metrics
+from consensus_specs_tpu.proofs import (
+    ProofCache,
+    ProofService,
+    leaf_gindex,
+    u64_column_chunks,
+)
+from consensus_specs_tpu.robustness.faults import FaultPlan, FaultSpec
+from consensus_specs_tpu.robustness.retry import RetryPolicy
+from consensus_specs_tpu.sched import MerkleWorkClass, Request, Scheduler
+from consensus_specs_tpu.ssz import (
+    Bitlist,
+    Container,
+    List,
+    build_chunk_proof,
+    build_proof,
+    build_proofs,
+    get_subtree_node_root,
+    hash_tree_root,
+    is_valid_merkle_branch,
+    merkleize_chunks,
+    uint64,
+)
+from consensus_specs_tpu.ssz.proofs import node_child, node_deref, to_node
+
+FAST_RETRY = RetryPolicy(max_attempts=4, base_delay=0.0, backoff=1.0,
+                         max_delay=0.0, jitter=0.0)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    from consensus_specs_tpu.compiler import get_spec
+
+    return get_spec("altair", "minimal")
+
+
+# --- helpers -----------------------------------------------------------------
+
+
+def _rand_chunks(rng, n):
+    return [rng.randint(0, 256, 32, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+
+
+def _rand_tree_gindices(rng, c_full, count):
+    """Random in-tree gindices: leaves, interior nodes, and the root."""
+    return [int(rng.randint(1, 2 * c_full)) for _ in range(count)]
+
+
+def _random_typed_gindices(value, rng, count):
+    """Random VALID gindices for a typed value, found by walking the node
+    tree top-down (stops where node_child refuses to descend — basic
+    leaves and absent zero-padded list slots)."""
+    out = []
+    for _ in range(count):
+        node, g = to_node(value), 1
+        while rng.rand() < 0.85:
+            node = node_deref(node)
+            bit = bool(rng.randint(0, 2))
+            try:
+                child = node_child(node, bit)
+            except ValueError:
+                break
+            node, g = child, g * 2 + int(bit)
+        out.append(g)
+    return out
+
+
+def _fresh_merkle_sched(**kw):
+    kw.setdefault("retry_policy", FAST_RETRY)
+    return Scheduler(classes=[MerkleWorkClass()], **kw)
+
+
+def _mixed_requests(rng, counts):
+    """Interleaved tree_root + multiproof workload over randomized trees
+    spanning several leaf-count buckets (query padding exercised by odd
+    per-bucket query counts)."""
+    reqs, oracle = [], []
+    for i, n in enumerate(counts):
+        chunks = _rand_chunks(rng, n)
+        c_full = 1 if n <= 1 else 1 << (n - 1).bit_length()
+        if i % 3 == 2:
+            reqs.append(Request(work_class="merkle", kind="tree_root",
+                                payload=(chunks,)))
+            oracle.append(bytes(merkleize_chunks(chunks)))
+        for g in _rand_tree_gindices(rng, c_full, int(rng.randint(1, 4))):
+            reqs.append(Request(work_class="merkle", kind="multiproof",
+                                payload=(chunks, g)))
+            oracle.append(tuple(build_chunk_proof(chunks, g)))
+    return reqs, oracle
+
+
+# --- host oracle: build_proofs / build_chunk_proof ---------------------------
+
+
+class _Inner(Container):
+    a: uint64
+    b: List[uint64, 64]
+
+
+class _Outer(Container):
+    x: uint64
+    inner: _Inner
+    scores: List[uint64, 2 ** 10]
+    flags: Bitlist[2 ** 8]
+
+
+def _typed_values(rng):
+    return [
+        _Outer(
+            x=uint64(int(rng.randint(0, 2 ** 32))),
+            inner=_Inner(a=uint64(3), b=List[uint64, 64](
+                *[uint64(int(v)) for v in rng.randint(0, 99, 5)])),
+            scores=List[uint64, 2 ** 10](
+                *[uint64(int(v)) for v in rng.randint(0, 2 ** 20, 33)]),
+            flags=Bitlist[2 ** 8](*[bool(b) for b in rng.randint(0, 2, 19)]),
+        ),
+        _Inner(a=uint64(0), b=List[uint64, 64]()),
+        List[uint64, 2 ** 10](*[uint64(i) for i in range(7)]),
+    ]
+
+
+def test_build_proofs_property_every_branch_verifies():
+    """Randomized gindices over Containers/Lists/Bitlists: build_proofs ==
+    per-gindex build_proof, and every branch passes is_valid_merkle_branch
+    against hash_tree_root — duplicates and ancestor/descendant mixes
+    included (the independence contract build_multiproof does NOT have)."""
+    rng = np.random.RandomState(1501)
+    for value in _typed_values(rng):
+        gs = _random_typed_gindices(value, rng, 40)
+        gs += [1, gs[0]]  # root query + a duplicate
+        branches = build_proofs(value, gs)
+        root = bytes(hash_tree_root(value))
+        assert len(branches) == len(gs)
+        for g, branch in zip(gs, branches):
+            assert branch == build_proof(value, g)
+            depth = g.bit_length() - 1
+            assert len(branch) == depth
+            leaf = get_subtree_node_root(value, g)
+            assert is_valid_merkle_branch(leaf, branch, depth,
+                                          g - (1 << depth), root)
+
+
+def test_build_chunk_proof_matches_merkleize_chunks():
+    """Chunk-tree oracle: every leaf branch (real and zero-padded)
+    verifies against merkleize_chunks' root, for counts on and off pow2."""
+    rng = np.random.RandomState(7)
+    for n in (1, 2, 3, 5, 8, 13):
+        chunks = _rand_chunks(rng, n)
+        root = bytes(merkleize_chunks(chunks))
+        c_full = 1 if n <= 1 else 1 << (n - 1).bit_length()
+        depth = (c_full - 1).bit_length()
+        for i in range(c_full):
+            g = c_full + i
+            branch = build_chunk_proof(chunks, g)
+            leaf = chunks[i] if i < n else bytes(32)
+            assert is_valid_merkle_branch(leaf, branch, depth, i, root)
+
+
+# --- the device kernel through the scheduler ---------------------------------
+
+
+def test_sched_multiproof_bit_identical_to_host_oracle():
+    """Randomized mixed tree_root+multiproof batches (several leaf-count
+    buckets, duplicate trees, interior/root gindices, odd query counts
+    forcing pow2 padding): every device branch is byte-identical to the
+    build_chunk_proof oracle, and tree_root results keep their legacy
+    shape alongside."""
+    rng = np.random.RandomState(42)
+    for counts in ((1, 3, 8, 5, 16, 2, 3), (4, 4, 7), (1,), (6, 6)):
+        reqs, oracle = _mixed_requests(rng, counts)
+        sch = _fresh_merkle_sched()
+        handles = [sch.submit(r) for r in reqs]
+        sch.drain()
+        got = [h.result() for h in handles]
+        assert got == oracle
+
+
+def test_sched_multiproof_degraded_matches_device():
+    """The pure-host fallback (execute_degraded) serves branches
+    byte-identical to the device path — the breaker can flip mid-storm
+    without readers seeing a different proof."""
+    rng = np.random.RandomState(9)
+    reqs, oracle = _mixed_requests(rng, (3, 8, 2))
+    cls = MerkleWorkClass()
+    device = [cls.to_result(row) for row in cls.execute(reqs)]
+    degraded = [cls.to_result(row) for row in cls.execute_degraded(reqs)]
+    assert device == oracle
+    assert degraded == oracle
+
+
+def test_sched_multiproof_rejects_bad_gindex():
+    chunks = _rand_chunks(np.random.RandomState(0), 4)
+    for bad in (0, -3, 8, 100):  # c_full=4 -> valid range [1, 8)
+        sch = _fresh_merkle_sched()
+        h = sch.submit(Request(work_class="merkle", kind="multiproof",
+                               payload=(chunks, bad)))
+        with pytest.raises(ValueError):
+            h.result()
+
+
+def test_multiproof_compile_pinned_one_per_bucket():
+    """One XLA compile per (kind, bucket) triple, zero recompiles on
+    replay, exactly one more on a new bucket — the CompileTracker pin
+    from the acceptance checklist."""
+    from consensus_specs_tpu.obs.recompile import CompileTracker
+
+    kernel = "_sibling_rows_impl"
+    tracker = CompileTracker(registry=obs_metrics.MetricsRegistry()).install()
+    try:
+        rng = np.random.RandomState(77)
+
+        def run(counts, queries_per_tree):
+            sch = _fresh_merkle_sched()
+            handles = []
+            for i, n in enumerate(counts):
+                # distinct deterministic trees: no dedup collapse
+                chunks = [bytes([(11 * i + j) % 251 + 1] * 32)
+                          for j in range(n)]
+                c_full = 1 if n <= 1 else 1 << (n - 1).bit_length()
+                for q in range(queries_per_tree):
+                    g = c_full + (q % c_full)
+                    handles.append(sch.submit(Request(
+                        work_class="merkle", kind="multiproof",
+                        payload=(chunks, g))))
+            sch.drain()
+            for i, h in enumerate(handles):
+                assert isinstance(h.result(), tuple)
+
+        base = tracker.compiles(kernel)
+        # two buckets: (K=2,C=4) with 6 queries -> Q=8; (K=1,C=2), Q=2
+        run((3, 4, 2), 3)
+        first = tracker.compiles(kernel) - base
+        assert first == 2
+        run((3, 4, 2), 3)  # replay: same buckets, zero recompiles
+        assert tracker.compiles(kernel) - base == first
+        run((3,) * 9, 1)  # new tree bucket (K=16,C=4,Q=16): exactly one
+        assert tracker.compiles(kernel) - base == first + 1
+        assert tracker.distinct_shapes(kernel) == first + 1
+    finally:
+        tracker.uninstall()
+
+
+def test_chaos_sched_multiproof_converges_bit_identical():
+    """Seeded raise + corrupt chaos at sched.dispatch over a mixed
+    tree_root+multiproof workload: absorbed faults retry from intact host
+    payloads and every run's branches stay byte-identical to the
+    fault-free oracle with the breaker closed."""
+    rng = np.random.RandomState(1234)
+    reqs, oracle = _mixed_requests(rng, (1, 3, 8, 5, 2))
+
+    def run():
+        sch = _fresh_merkle_sched()
+        handles = [sch.submit(r) for r in reqs]
+        sch.drain()
+        got = [h.result() for h in handles]
+        assert sch.breaker("merkle").state == "closed"
+        return got
+
+    assert run() == oracle  # fault-free sanity
+    schedules = (
+        dict(kind="raise", at_calls=(1, 2), exc="transient"),
+        dict(kind="raise", at_calls=(1,), exc="xla"),
+        dict(kind="corrupt", at_calls=(1,), corruption="nan"),
+        dict(kind="corrupt", at_calls=(1,), corruption="truncate"),
+    )
+    for kw in schedules:
+        plan = FaultPlan(seed=15, sites={"sched.dispatch": FaultSpec(**kw)})
+        with plan.active():
+            got = run()
+        assert got == oracle
+        assert plan.fired_sites() == {"sched.dispatch"}
+
+
+def test_chaos_sched_multiproof_hard_down_degrades_to_host():
+    """A hard-down dispatch exhausts the retry budget, opens the merkle
+    breaker, and the batch is served from the build_chunk_proof host
+    fallback — byte-identical to the fault-free oracle."""
+    rng = np.random.RandomState(5150)
+    reqs, oracle = _mixed_requests(rng, (3, 8, 2))
+    sch = _fresh_merkle_sched(failure_threshold=1)
+    plan = FaultPlan(seed=5, sites={
+        "sched.dispatch": FaultSpec(kind="raise", rate=1.0,
+                                    max_fires=FAST_RETRY.max_attempts,
+                                    exc="transient"),
+    })
+    with plan.active():
+        handles = [sch.submit(r) for r in reqs]
+        sch.drain()
+        got = [h.result() for h in handles]
+    assert got == oracle
+    assert sch.breaker("merkle").state == "open"
+
+
+# --- the proof cache ---------------------------------------------------------
+
+
+def test_proof_cache_hit_miss_and_gauges():
+    reg = obs_metrics.MetricsRegistry()
+    cache = ProofCache(registry=reg)
+    assert cache.lookup("balances", 9) is None
+    cache.store("balances", 9, (b"\x01" * 32, b"\x02" * 32))
+    assert cache.lookup("balances", 9) == (b"\x01" * 32, b"\x02" * 32)
+    assert reg.counter_value("proof_cache_misses_total", column="balances") == 1
+    assert reg.counter_value("proof_cache_hits_total", column="balances") == 1
+    assert reg.gauge_value("proof_cache_hit_ratio") == 0.5
+    assert reg.gauge_value("proof_cache_entries") == 1
+    assert cache.size() == 1
+
+
+def test_proof_cache_exact_single_column_invalidation():
+    """Two synthetic columns; mutate ONE between epochs. Exactly the
+    mutated column's entries drop (counter ticks by that count), the
+    clean column serves bit-identical branches from cache, and the dirty
+    column's re-proofs match fresh host proofs over the NEW data."""
+    reg = obs_metrics.MetricsRegistry()
+    svc = ProofService(registry=reg)
+    data = {"balances": np.arange(40, dtype=np.uint64) * 11,
+            "inactivity_scores": np.arange(40, dtype=np.uint64) * 3}
+    for name in data:
+        svc.register_column(
+            name, lambda name=name: u64_column_chunks(data[name]))
+    n_chunks = len(u64_column_chunks(data["balances"]))  # 10 -> c_full 16
+    queries = [(name, leaf_gindex(i, n_chunks))
+               for name in data for i in (0, 4, 9)]
+    first = svc.prove_many(queries)
+    for (name, g), branch in zip(queries, first):
+        assert list(branch) == build_chunk_proof(
+            u64_column_chunks(data[name]), g)
+
+    data["balances"] = data["balances"].copy()
+    data["balances"][7] += 1_000_000
+    dropped = svc.note_epoch({"balances": True, "inactivity_scores": False})
+    assert dropped == 3
+    assert svc.cache.entries("balances") == {}
+    assert len(svc.cache.entries("inactivity_scores")) == 3
+    assert reg.counter_value("proof_cache_invalidated_total",
+                             column="balances") == 3
+    assert reg.counter_value("proof_cache_invalidated_total",
+                             column="inactivity_scores") == 0
+
+    hits_before = reg.counter_value("proof_cache_hits_total",
+                                    column="inactivity_scores")
+    second = svc.prove_many(queries)
+    for (name, g), branch, old in zip(queries, second, first):
+        assert list(branch) == build_chunk_proof(
+            u64_column_chunks(data[name]), g)
+        if name == "inactivity_scores":
+            assert branch == old  # clean column: cache-served, unchanged
+    assert reg.counter_value("proof_cache_hits_total",
+                             column="inactivity_scores") - hits_before == 3
+
+
+def test_proof_service_unregistered_column_raises():
+    svc = ProofService(registry=obs_metrics.MetricsRegistry())
+    with pytest.raises(KeyError):
+        svc.prove("no_such_column", 1)
+
+
+def test_proof_service_latency_histogram_observes_per_query():
+    reg = obs_metrics.MetricsRegistry()
+    svc = ProofService(registry=reg)
+    col = np.arange(8, dtype=np.uint64)
+    svc.register_column("c", lambda: u64_column_chunks(col))
+    svc.prove_many([("c", leaf_gindex(i, 2)) for i in range(2)])
+    snap = reg.snapshot()
+    hist = snap["histograms"]["proof_request_latency_seconds"]
+    assert hist["count"] == 2
+    assert reg.counter_value("proof_requests_total") == 2
+
+
+def test_proof_cache_after_run_epochs_bit_identical(spec):
+    """The acceptance scenario: prove against a resident engine's columns,
+    run real epochs, feed `dirty_columns()` into the cache, and assert
+    (a) balances invalidated (rewards/penalties moved them), (b) each
+    column's entries dropped or survived exactly per its dirty flag, and
+    (c) every post-epoch proof — cache hit or fresh — is byte-identical
+    to a fresh host proof over the engine's CURRENT column values."""
+    from consensus_specs_tpu.crypto import bls
+    from consensus_specs_tpu.engine.resident import ResidentEpochEngine
+    from consensus_specs_tpu.testlib.state import prepared_epoch_state
+
+    was = bls.bls_active
+    bls.bls_active = False
+    try:
+        st = prepared_epoch_state(spec, start_epoch=6, seed=21)
+        eng = ResidentEpochEngine(spec, st)
+        reg = obs_metrics.MetricsRegistry()
+        svc = ProofService(registry=reg)
+        cols = ("balances", "activation_epoch", "activation_eligibility_epoch")
+
+        def chunks_of(name):
+            return u64_column_chunks(np.asarray(getattr(eng.dev, name)))
+
+        for name in cols:
+            svc.register_column(name, lambda name=name: chunks_of(name))
+        n_chunks = len(chunks_of("balances"))
+        queries = [(name, leaf_gindex(i, n_chunks))
+                   for name in cols for i in range(min(4, n_chunks))]
+        per_col = len(queries) // len(cols)
+        before = svc.prove_many(queries)
+        for (name, g), branch in zip(queries, before):
+            assert list(branch) == build_chunk_proof(chunks_of(name), g)
+
+        eng.run_epochs(3)
+        dirty = eng.dirty_columns()
+        assert dirty["balances"]  # rewards/penalties moved balances
+        clean = [c for c in cols if not dirty[c]]
+        assert clean  # no activations pending: activation columns stay put
+        svc.note_epoch(dirty)
+        for name in cols:
+            n_cached = len(svc.cache.entries(name))
+            assert n_cached == (0 if dirty[name] else per_col)
+
+        hits0 = {c: reg.counter_value("proof_cache_hits_total", column=c)
+                 for c in cols}
+        after = svc.prove_many(queries)
+        for (name, g), branch, old in zip(queries, after, before):
+            assert list(branch) == build_chunk_proof(chunks_of(name), g)
+            if name in clean:
+                assert branch == old
+        for name in cols:
+            got = reg.counter_value("proof_cache_hits_total",
+                                    column=name) - hits0[name]
+            assert got == (0 if dirty[name] else per_col)
+    finally:
+        bls.bls_active = was

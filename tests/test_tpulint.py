@@ -73,6 +73,9 @@ def test_dtype_pin_fixture():
     bad = (FIXTURES / "ops" / "dtype_bad.py").read_text().splitlines()
     fori_lines = [i for i, l in enumerate(bad, 1) if "fori_loop(0, 64" in l]
     assert fori_lines and all((i, "dtype-pin") in expected for i in fori_lines)
+    # the PR-15 multiproof level-walk pair: bare bounds flagged, pinned clean
+    walk_lines = [i for i, l in enumerate(bad, 1) if "fori_loop(0, depth" in l]
+    assert walk_lines and all((i, "dtype-pin") in expected for i in walk_lines)
 
 
 def test_donation_fixture():
@@ -98,11 +101,13 @@ def test_layering_fixture():
     assert "bad_dispatch.py" in by_file  # sched/ module-level jax
     assert "bad_stream.py" in by_file  # firehose/ module-level jax
     assert "bad_driver.py" in by_file  # scenarios/ module-level jax
+    assert "bad_cache.py" in by_file  # proofs/ module-level jax
     for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
                   "recompile.py",  # recompile: obs install-deferral pattern
                   "queue.py",  # sched: executor-deferral pattern
                   "stream.py",  # firehose: host-orchestrator pattern
-                  "driver.py"):  # scenarios: lane-deferral pattern
+                  "driver.py",  # scenarios: lane-deferral pattern
+                  "cache.py"):  # proofs: miss-path-deferral pattern
         assert clean not in by_file
 
 

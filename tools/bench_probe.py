@@ -42,6 +42,8 @@ BENCH_QUICK_ENV = {
     "BENCH_SR_VALIDATORS": "262144",
     "BENCH_E2E_VALIDATORS": "1048576",
     "BENCH_MSM_N": "64",
+    "BENCH_PROOF_VALIDATORS": "1048576",
+    "BENCH_PROOF_QUERIES": "2048",
 }
 
 
@@ -98,6 +100,9 @@ def check_e2e_lane() -> int:
     if rc:
         return rc
     rc = check_msm_lane(extra)
+    if rc:
+        return rc
+    rc = check_proof_lane(extra)
     if rc:
         return rc
     return check_obs_snapshot()
@@ -194,6 +199,31 @@ def check_msm_lane(extra: dict) -> int:
           f"(items/s={extra['msm_items_per_s']}, "
           f"speedup={extra['msm_vs_ladder_speedup']}x at "
           f"n={extra['msm_n']} w={extra['msm_window']})", file=sys.stderr)
+    return 0
+
+
+def check_proof_lane(extra: dict) -> int:
+    """Refuse a record without the light-client read lane: warm proofs/s
+    is the serving headline (batched device multiproofs + dirty-column
+    cache), the hit ratio proves the cache actually absorbed the clean
+    columns across epoch advances, and the p99 comes from the lane's own
+    request histogram under concurrent write-path load. A bench that
+    dropped the lane would keep reporting write-path numbers as if the
+    read half of the production story were still measured."""
+    missing = [k for k in ("proof_proofs_per_s_warm",
+                           "proof_cache_hit_ratio",
+                           "proof_p99_request_s")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"light-client proof read lane (missing {missing}); fix "
+              f"benches/proof_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: proof lane present "
+          f"(warm={extra['proof_proofs_per_s_warm']}/s, "
+          f"hit_ratio={extra['proof_cache_hit_ratio']}, "
+          f"p99={extra['proof_p99_request_s']}s)", file=sys.stderr)
     return 0
 
 
