@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched msm firehose scenarios proofs slo lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched msm firehose scenarios proofs forkchoice slo lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -119,6 +119,20 @@ proofs:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_proofs.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_proofs.json
+
+# Fork-choice head lane: the device-resident LMD-GHOST tracker (ops +
+# engine + the sched "forkchoice" kind + forkchoice/ service) pinned
+# bit-identical against the spec's get_head across the three scenario
+# lanes, chaos and breaker-open hard-down included — see README "Fork
+# choice". Obs snapshot validated like the sibling lanes; the
+# forkchoice_* series are the artifact.
+forkchoice:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_forkchoice.json OBS_SNAPSHOT_LANE=forkchoice \
+	OBS_FLIGHT_DIR=test-results \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_forkchoice.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_forkchoice.json
 
 # Declarative SLO gate (slo.json at the repo root): the bench trajectory
 # and obs-snapshot invariants as machine-checked objectives — see README

@@ -240,6 +240,10 @@ def run_benches() -> dict:
             import benches.proof_bench as proof_bench
 
             proof_r = proof_bench.run()
+        with timed("bench_forkchoice"):
+            import benches.forkchoice_bench as forkchoice_bench
+
+            fc_r = forkchoice_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -364,6 +368,18 @@ def run_benches() -> dict:
             "proof_vs_host_speedup": proof_r["proof_vs_host_speedup"],
             "proof_queries": proof_r["proof_queries"],
             "proof_write_epochs": proof_r["proof_write_epochs"],
+            # fork-choice head lane: reorg-storm soak over a contested
+            # tree at registry scale, every verified batch folded through
+            # the service's firehose seam; head lag (verified -> head
+            # reflecting it) from the lane's own histogram, device batch
+            # cross-checked bit-identical against the host oracle
+            "forkchoice_heads_per_s": fc_r["forkchoice_heads_per_s"],
+            "forkchoice_head_lag_p99_s": fc_r["forkchoice_head_lag_p99_s"],
+            "forkchoice_head_flips": fc_r["forkchoice_head_flips"],
+            "forkchoice_vs_host_speedup":
+                fc_r["forkchoice_vs_host_speedup"],
+            "forkchoice_blocks": fc_r["forkchoice_blocks"],
+            "forkchoice_validators": fc_r["forkchoice_validators"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],
@@ -448,6 +464,12 @@ def main() -> None:
         # proofs/s and hit-ratio shape is what's measured)
         os.environ.setdefault("BENCH_PROOF_VALIDATORS", "65536")
         os.environ.setdefault("BENCH_PROOF_QUERIES", "1024")
+        # fork-choice head lane: smaller registry + tree (the dense
+        # O(blocks x validators) masked segment-sum is the accelerator
+        # mapping; on CPU the heads/s and head-lag shape is what's
+        # measured, not the device-vs-host ratio)
+        os.environ.setdefault("BENCH_FC_VALIDATORS", "16384")
+        os.environ.setdefault("BENCH_FC_BLOCKS", "256")
     try:
         record = run_benches()
         if N_VALIDATORS >= 1_048_576:

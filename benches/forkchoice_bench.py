@@ -1,0 +1,219 @@
+"""Fork-choice head lane (consensus_specs_tpu/forkchoice/ + the sched
+"forkchoice" kind).
+
+Measured region: a reorg-storm soak over a seeded contested block tree at
+registry scale — two heavy branches whose LMD weight keeps crossing as
+verified-attestation batches land, every batch folded through the
+ForkChoiceService's `note_verified` seam (the same callback the firehose
+invokes per sealed flush), every head recomputed on device through the
+sched lane. Reported: heads/s in steady state, head-lag p50/p99 from the
+lane's OWN histogram (`forkchoice_head_lag_seconds` — the SLO series, the
+wall-clock from "attestation verified" to "a head reflecting it"; the
+registry resets after an unmeasured warm-up round so the histogram
+aggregates steady-state rounds only), the number of head flips observed
+(a soak that never flips is not a reorg storm), and one batched device
+launch over many vote-perturbed snapshots vs the per-query
+`reference.host_head` loop on identical inputs, cross-checked
+bit-identical before either side is timed.
+
+Traffic shape: `BENCH_FC_VALIDATORS` validators (default 65_536; bench.py
+clamps the cpu-debug lane), `BENCH_FC_BLOCKS` blocks branching into two
+contested lineages, `BENCH_FC_HEADS` verified batches per round, each
+swinging a random validator slice between the branch tips.
+
+Usage: python benches/forkchoice_bench.py — one JSON line, persisted to
+BENCH_LOCAL.json. BENCH_FC_VALIDATORS / BENCH_FC_BLOCKS / BENCH_FC_ROUNDS
+/ BENCH_FC_HEADS / BENCH_FC_BATCH size the lane.
+"""
+import json
+import os
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+GWEI_32 = 32_000_000_000
+
+
+def default_counts() -> dict:
+    return {
+        "validators": int(os.environ.get("BENCH_FC_VALIDATORS", 65_536)),
+        "blocks": int(os.environ.get("BENCH_FC_BLOCKS", 512)),
+        "rounds": int(os.environ.get("BENCH_FC_ROUNDS", 3)),
+        "heads": int(os.environ.get("BENCH_FC_HEADS", 16)),
+        "batch": int(os.environ.get("BENCH_FC_BATCH", 8)),
+    }
+
+
+def _build_storm(counts: dict):
+    """Seeded contested tree: one trunk forking into two heavy lineages
+    (the storm swings votes between their tips), plus stray side branches
+    so the ancestor walk and FFG filter see real shape, not a path."""
+    import numpy as np
+
+    from consensus_specs_tpu.forkchoice import StoreMirror
+
+    rng = random.Random(2302)
+    m = StoreMirror()
+    anchor = bytes(32)
+    ck = (0, anchor)
+    m.add_block(anchor, anchor, 0, justified=ck, finalized=ck)
+    roots = [anchor]
+    slots = {anchor: 0}
+
+    def add(parent):
+        root = rng.randbytes(32)
+        slots[root] = slots[parent] + 1
+        m.add_block(root, parent, slots[root], justified=ck, finalized=ck)
+        roots.append(root)
+        return root
+
+    trunk = anchor
+    n_trunk = max(2, counts["blocks"] // 8)
+    for _ in range(n_trunk):
+        trunk = add(trunk)
+    tips = [trunk, trunk]
+    lineage: list = [[], []]
+    for i in range(counts["blocks"] - n_trunk - 1):
+        side = i % 2
+        if rng.random() < 0.15 and lineage[side]:
+            parent = rng.choice(lineage[side])  # stray fork off the branch
+            add(parent)
+        else:
+            tips[side] = add(tips[side])
+            lineage[side].append(tips[side])
+    m.set_registry(np.full(counts["validators"], GWEI_32, dtype=np.int64))
+    for v in range(counts["validators"]):
+        m.set_vote(v, lineage[v % 2][-1] if lineage[v % 2] else trunk)
+    m.set_checkpoints(ck, ck)
+    return m, lineage, rng
+
+
+def run(counts: dict | None = None) -> dict:
+    import numpy as np
+
+    from consensus_specs_tpu.engine.fork_choice import ghost_head_batch
+    from consensus_specs_tpu.forkchoice import ForkChoiceService, host_head
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+    from consensus_specs_tpu.sched import ForkChoiceWorkClass, Scheduler
+
+    if counts is None:
+        counts = default_counts()
+
+    t0 = time.time()
+    mirror, lineage, rng = _build_storm(counts)
+    print(f"# forkchoice tree build ({len(mirror)} blocks, "
+          f"{counts['validators']} validators): {time.time() - t0:.1f}s",
+          file=sys.stderr)
+
+    reg = obs_metrics.MetricsRegistry()
+    svc = ForkChoiceService(
+        scheduler=Scheduler(classes=[ForkChoiceWorkClass()], registry=reg),
+        registry=reg)
+    svc.mirror = mirror
+
+    def one_batch(epoch: int) -> bytes:
+        """One verified-attestation batch: a random validator slice swings
+        to one branch tip, then the head recomputes through the service's
+        firehose-facing seam (head lag observed per record)."""
+        side = rng.randrange(2)
+        tip = lineage[side][-1]
+        base = rng.randrange(counts["validators"])
+        indices = [(base + j) % counts["validators"]
+                   for j in range(max(1, counts["validators"] // 8))]
+        svc.apply_votes(indices, epoch, tip)
+        now = time.monotonic()
+        return svc.note_verified([(b"%020d" % epoch, (0, 0, tip), True, now)])
+
+    # warm-up round: pays the (blocks, validators) bucket's XLA compile and
+    # the first mirror snapshot, then the registry resets so the histogram
+    # and counters aggregate steady-state rounds only
+    t0 = time.time()
+    epoch = 1
+    for _ in range(counts["heads"]):
+        one_batch(epoch)
+        epoch += 1
+    print(f"# forkchoice warm-up round (compile included): "
+          f"{time.time() - t0:.1f}s", file=sys.stderr)
+    reg.reset()
+
+    flips = 0
+    last = None
+    t0 = time.time()
+    for r in range(counts["rounds"]):
+        for _ in range(counts["heads"]):
+            head = one_batch(epoch)
+            epoch += 1
+            if last is not None and head != last:
+                flips += 1
+            last = head
+    soak_dt = time.time() - t0
+    n_heads = counts["rounds"] * counts["heads"]
+    hist = reg.histogram("forkchoice_head_lag_seconds")
+    assert hist.count == n_heads
+    print(f"# forkchoice soak: {n_heads} heads in {soak_dt:.1f}s "
+          f"({flips} flips)", file=sys.stderr)
+
+    # batched device launch vs the per-query host-oracle loop on identical
+    # vote-perturbed snapshots — cross-checked bit-identical BEFORE either
+    # side is timed, so the speedup compares verified-equal computations
+    snaps = []
+    for _ in range(counts["batch"]):
+        side = rng.randrange(2)
+        base = rng.randrange(counts["validators"])
+        for j in range(counts["validators"] // 16):
+            mirror.set_vote((base + j) % counts["validators"],
+                            lineage[side][-1])
+        snaps.append(mirror.snapshot())
+    device_heads = [int(h) for h in ghost_head_batch(snaps)]  # compile pass
+    host_heads = [host_head(s) for s in snaps]
+    assert device_heads == host_heads, (
+        "device head batch diverged from the host oracle on identical "
+        "snapshots")
+    t0 = time.time()
+    device_heads = [int(h) for h in ghost_head_batch(snaps)]
+    device_dt = time.time() - t0
+    t0 = time.time()
+    host_heads = [host_head(s) for s in snaps]
+    host_dt = time.time() - t0
+    assert device_heads == host_heads
+    speedup = host_dt / max(device_dt, 1e-9)
+    print(f"# forkchoice device batch {device_dt:.3f}s vs host loop "
+          f"{host_dt:.3f}s ({speedup:.1f}x, cross-checked)", file=sys.stderr)
+
+    return {
+        "forkchoice_heads_per_s": round(n_heads / soak_dt, 2),
+        "forkchoice_head_lag_p99_s": round(hist.p99(), 4),
+        "forkchoice_head_lag_p50_s": round(hist.p50(), 4),
+        "forkchoice_head_flips": flips,
+        "forkchoice_vs_host_speedup": round(speedup, 2),
+        "forkchoice_blocks": len(mirror),
+        "forkchoice_validators": counts["validators"],
+        "forkchoice_counts": {k: counts[k] for k in
+                              ("blocks", "rounds", "heads", "batch")},
+    }
+
+
+def main():
+    from consensus_specs_tpu.utils.backend import enable_compile_cache, force_cpu
+
+    force_cpu()
+    enable_compile_cache()
+    import bench
+
+    r = run()
+    record = {
+        "metric": "forkchoice_heads_per_s",
+        "value": r["forkchoice_heads_per_s"],
+        "unit": "heads/sec",
+        "vs_baseline": None,
+        "extra": r,
+    }
+    bench.persist_local(record)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

@@ -36,12 +36,17 @@ class LayeringConfig:
     jax_free: tuple[str, ...] = (
         "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
         "crypto/das.py", "robustness/", "obs/", "sched/", "firehose/",
-        "scenarios/", "proofs/",
+        "scenarios/", "proofs/", "forkchoice/",
     )
     # (importer pattern, forbidden import pattern) over module paths
     forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)
     test_only: tuple[str, ...] = ("testlib/",)
-    test_consumers: tuple[str, ...] = ("testlib/", "spec_tests/", "scenarios/")
+    # forkchoice/ consumes testlib/fork_choice.py BY DESIGN: the spec-shaped
+    # LMD/FFG semantics (latest-message filter, ancestor walk) are extracted
+    # there once and shared between the spec_tests and the production mirror,
+    # so the two can never drift apart silently
+    test_consumers: tuple[str, ...] = ("testlib/", "spec_tests/",
+                                       "scenarios/", "forkchoice/")
     # external import roots that count as "jax"
     jax_roots: tuple[str, ...] = ("jax", "jaxlib")
     # package-internal module basenames that imply jax regardless of content

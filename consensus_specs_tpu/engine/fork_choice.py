@@ -1,0 +1,70 @@
+"""Host entry for the device LMD-GHOST kernel: bucket, pad, launch.
+
+Snapshots group by their pow2 (blocks, validators) bucket — one jitted
+program per bucket, exactly the multiproof read lane's compile-cache
+discipline — and each group pads its query axis to a pow2 count by
+replicating the first member (discarded). Block-axis pads are self-looped
+unreal rows (isolated in the ancestor matrix, excluded from every mask);
+validator-axis pads vote -1 with balance 0 (never match the segment-sum
+lane).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..forkchoice.mirror import StoreSnapshot
+from ..sched import bucketing
+
+MIN_BLOCK_BUCKET = 8
+MIN_VALIDATOR_BUCKET = 64
+
+
+def _padded_member(snap: StoreSnapshot, b: int, v: int) -> tuple:
+    n, nv = snap.n_blocks, snap.n_validators
+    parent = np.arange(b, dtype=np.int32)
+    parent[:n] = snap.parent
+    root_words = np.zeros((b, 8), dtype=np.uint32)
+    root_words[:n] = snap.root_words
+    ck_epochs = np.zeros((b, 2), dtype=np.int64)
+    ck_epochs[:n] = snap.ck_epochs
+    ck_rids = np.full((b, 2), -1, dtype=np.int32)
+    ck_rids[:n] = snap.ck_rids
+    is_real = np.zeros(b, dtype=bool)
+    is_real[:n] = True
+    votes = np.full(v, -1, dtype=np.int32)
+    votes[:nv] = snap.votes
+    balances = np.zeros(v, dtype=np.int64)
+    balances[:nv] = snap.balances
+    idx_scalars = np.asarray(
+        [snap.justified_idx, snap.boost_idx,
+         snap.store_justified[1], snap.store_finalized[1]], dtype=np.int32)
+    ep_scalars = np.asarray(
+        [snap.store_justified[0], snap.store_finalized[0],
+         snap.genesis_epoch, snap.boost_weight], dtype=np.int64)
+    return (parent, root_words, ck_epochs, ck_rids, is_real, votes,
+            balances, idx_scalars, ep_scalars)
+
+
+def ghost_head_batch(snapshots: list) -> np.ndarray:
+    """(n,) int32 head block indices, one per StoreSnapshot, in order."""
+    from ..ops.forkchoice_jax import ghost_head_bucket
+
+    out = np.empty(len(snapshots), dtype=np.int32)
+    groups: dict = {}
+    for i, snap in enumerate(snapshots):
+        key = (bucketing.pow2_bucket(max(1, snap.n_blocks),
+                                     MIN_BLOCK_BUCKET),
+               bucketing.pow2_bucket(max(1, snap.n_validators),
+                                     MIN_VALIDATOR_BUCKET))
+        groups.setdefault(key, []).append(i)
+    for (b, v), members in sorted(groups.items()):
+        q = bucketing.pow2_bucket(len(members), 1)
+        rows = [_padded_member(snapshots[i], b, v) for i in members]
+        rows.extend([rows[0]] * (q - len(rows)))
+        batch = [np.stack(arrs) for arrs in zip(*rows)]
+        heads = np.asarray(jax.device_get(ghost_head_bucket(*batch)),
+                           dtype=np.int32)
+        for row, i in enumerate(members):
+            out[i] = heads[row]
+    return out

@@ -127,6 +127,7 @@ class AttestationFirehose:
         self._awaiting: list = []   # (msg_id, key, handle, t_ingest)
         self._dead: list = []       # records whose handle failed (restore())
         self._results: dict = {}    # msg_id -> bool
+        self._verified_subs: list = []  # verified-batch consumer callbacks
         self._pending = 0           # members between ingest and verified
         self._peak = 0
         self._rate_ewma = 0.0       # admitted members/second (EWMA)
@@ -454,6 +455,22 @@ class AttestationFirehose:
             reg.counter("firehose_verified_total").inc(verified)
         if rejected:
             reg.counter("firehose_rejected_total").inc(rejected)
+        if done and self._verified_subs:
+            # consumer seam (the ProofService dirty-column precedent):
+            # one batch record per resolved verdict, delivered OUTSIDE the
+            # lock so a consumer may call back into the pipeline. A
+            # subscriber fault is the subscriber's incident, not the
+            # stream's — counted, flight-recorded, never re-raised.
+            batch = [(msg_id, key, self._results[msg_id], now)
+                     for msg_id, key, _handle, _t in done]
+            for callback in list(self._verified_subs):
+                try:
+                    callback(batch)
+                except Exception as exc:
+                    reg.counter("firehose_subscriber_errors_total").inc()
+                    _flight.record(
+                        "firehose_subscriber_error",
+                        error=type(exc).__name__, detail=str(exc)[:200])
         if first_error is not None:
             raise FirehoseKilled(
                 "flush resolved handles with executor errors; restore() "
@@ -524,6 +541,18 @@ class AttestationFirehose:
     def peak_depth(self) -> int:
         with self._lock:
             return self._peak
+
+    def subscribe_verified(self, callback) -> None:
+        """Register a verified-batch consumer: after every collect pass
+        that resolves verdicts, `callback(records)` fires with the batch's
+        `(msg_id, key, ok, t_verified)` tuples (key = the committee
+        (slot, index, beacon_block_root) from classification, t_verified
+        the monotonic resolve time). Callbacks run on the resolving
+        thread, outside the pipeline lock; exceptions are counted and
+        flight-recorded, never propagated into the stream. This is the
+        seam ForkChoiceService recomputes the head per sealed flush on."""
+        with self._lock:
+            self._verified_subs.append(callback)
 
     def results(self) -> dict:
         """{msg_id: bool} snapshot of every resolved attestation."""

@@ -1,0 +1,22 @@
+"""Device-resident fork choice: LMD-GHOST head tracking as a batched lane.
+
+The store mirrored in gather form (mirror.py), a spec-shaped host oracle
+(reference.py), and a service front end (service.py) over the sched
+"forkchoice" work class — the kernel itself lives in
+ops/forkchoice_jax.py behind engine/fork_choice.py, keeping this package
+jax-free by charter.
+"""
+from .mirror import StoreMirror, StoreSnapshot, ZERO_ROOT
+from .reference import filtered_mask, host_head, subtree_weights
+from .service import ForkChoiceService, LatestMessage
+
+__all__ = [
+    "ForkChoiceService",
+    "LatestMessage",
+    "StoreMirror",
+    "StoreSnapshot",
+    "ZERO_ROOT",
+    "filtered_mask",
+    "host_head",
+    "subtree_weights",
+]

@@ -1,0 +1,124 @@
+"""ForkChoiceService: the resident head tracker over the sched lane.
+
+The write lane's missing consumer: a service that mirrors a Store (or a
+directly-driven vote feed), submits "forkchoice"/"head" work, and keeps
+the current head fresh as verified attestations land. It subscribes to
+the firehose's verified-batch output — the same consumer seam
+ProofService uses for dirty columns — recomputing the head once per
+sealed flush and observing `forkchoice_head_lag_seconds` per verified
+attestation: the wall-clock from "verified" to "a head reflecting it",
+the series the head-lag SLO gates.
+
+Every head query crosses sched.dispatch, so it inherits the breaker /
+retry / span envelope for free: transient device faults retry, hard-down
+degrades to the spec-shaped host oracle (`reference.host_head`) with
+bit-identical answers.
+
+jax-free by charter — the device never appears above the work class.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import namedtuple
+
+from ..obs import metrics as obs_metrics
+from ..sched.api import Request
+from ..testlib.fork_choice import latest_message_updates
+from .mirror import StoreMirror
+
+LatestMessage = namedtuple("LatestMessage", ("epoch", "root"))
+
+
+class ForkChoiceService:
+    """Track the LMD-GHOST head of a mirrored store via the sched lane."""
+
+    def __init__(self, scheduler=None,
+                 registry: obs_metrics.MetricsRegistry | None = None):
+        self.registry = (registry if registry is not None
+                         else obs_metrics.REGISTRY)
+        self.mirror = StoreMirror()
+        self._scheduler = scheduler
+        self._spec = None
+        self._store = None
+        self._latest: dict = {}   # direct-drive latest messages
+        self._lock = threading.Lock()
+        self._head_lag = self.registry.histogram(
+            "forkchoice_head_lag_seconds")
+        self._heads = self.registry.counter("forkchoice_heads_total")
+        self._blocks = self.registry.gauge("forkchoice_mirror_blocks")
+
+    def _sched(self):
+        if self._scheduler is None:
+            from ..sched.scheduler import default_scheduler
+
+            self._scheduler = default_scheduler()
+        return self._scheduler
+
+    # --- store mirroring ---------------------------------------------------
+
+    def attach(self, spec, store) -> None:
+        """Bind a Store; every head query re-syncs the mirror first."""
+        self._spec, self._store = spec, store
+        self.sync()
+
+    def sync(self) -> None:
+        if self._store is not None:
+            self.mirror.sync(self._spec, self._store)
+        self._blocks.set(len(self.mirror))
+
+    # --- direct vote drive (no Store: firehose feeds, bench, tests) --------
+
+    def apply_votes(self, attesting_indices, target_epoch,
+                    beacon_block_root) -> list:
+        """Admit one verified attestation's votes through the spec's
+        `update_latest_messages` filter (testlib's extracted helper) and
+        fold the admitted ones into the mirror's vote lane. Returns the
+        validator indices actually updated."""
+        root = bytes(beacon_block_root)
+        updated = latest_message_updates(
+            self._latest, attesting_indices, target_epoch)
+        for i in updated:
+            self._latest[i] = LatestMessage(int(target_epoch), root)
+            self.mirror.set_vote(int(i), root)
+        return updated
+
+    # --- head queries ------------------------------------------------------
+
+    def head_index(self) -> int:
+        """Current head as an index into the mirror's block table."""
+        self.sync()
+        snap = self.mirror.snapshot()
+        sched = self._sched()
+        handle = sched.submit(Request(
+            work_class="forkchoice", kind="head", payload=(snap,)))
+        sched.flush("forkchoice")
+        index = int(handle.result())
+        self._heads.inc()
+        return index
+
+    def head(self) -> bytes:
+        """Current head root (32 bytes)."""
+        return self.mirror.root_at(self.head_index())
+
+    # --- firehose consumer seam --------------------------------------------
+
+    def subscribe(self, firehose) -> None:
+        """Attach to a firehose's verified-batch seam: every sealed flush
+        triggers one incremental head recompute."""
+        firehose.subscribe_verified(self.note_verified)
+
+    def note_verified(self, records) -> bytes | None:
+        """Verified-batch callback: records are (msg_id, key, ok,
+        t_verified) tuples from the firehose collector. Recomputes the
+        head once for the whole batch and observes per-record head lag;
+        returns the new head root (None when nothing verified)."""
+        verified = [r for r in records if r[2]]
+        if not verified:
+            return None
+        with self._lock:
+            head = self.head()
+            now = time.monotonic()
+            for _msg_id, _key, _ok, t_verified in verified:
+                self._head_lag.observe(max(0.0, now - float(t_verified)))
+        return head

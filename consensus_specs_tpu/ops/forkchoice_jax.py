@@ -1,0 +1,158 @@
+"""Batched LMD-GHOST head selection as a JAX kernel (the fork-choice lane).
+
+Device twin of the spec's `get_head` (phase0/fork-choice.md: greedy
+child-walk from the justified root maximizing
+`(get_latest_attesting_balance, root)`), over a store mirrored in gather
+form: the block tree as parent-pointer indices, per-validator latest
+messages as a `(V,)` vote-index vector, per-block FFG checkpoints as
+interned root ids + epochs.
+
+Three gather-form stages, no scatter anywhere:
+
+  1. **Ancestor matrix by pointer doubling.** `anc[i, j]` = "j is an
+     ancestor-or-self of i", grown from the identity in `log2(B)` steps of
+     `anc |= anc[jump]; jump = jump[jump]` — the multiproof kernel's
+     level-walk idiom lifted to whole-tree reachability. Because slots
+     strictly increase parent -> child, `get_ancestor(store, vote_root,
+     candidate.slot) == candidate` is exactly "candidate is
+     ancestor-or-self of vote_root", so no slot data is needed on device.
+  2. **Masked segment-sum vote weights** — the `g1_segment_sum` tree idiom
+     on int64 Gwei: a `(V_chunk, B)` equality mask against the block-index
+     lane, summed per chunk inside an int32-pinned `fori_loop` (vote -1 =
+     "no message" never matches). Subtree weights are then one masked
+     reduction over the ancestor matrix; proposer boost is a single row
+     gather.
+  3. **Viability + head walk.** `filter_block_tree`'s leaf rule (store
+     justified/finalized agreement, with the GENESIS_EPOCH escapes) is a
+     per-block predicate; a node is viable iff some agreeing leaf sits in
+     its ancestor column. The head walk is an int32-pinned `fori_loop` of
+     B greedy steps, each an argmax over `(weight, root)` realized as a
+     lexicographic mask refinement: weight first, then the 8 big-endian
+     root words most-significant first — bit-identical to the spec's
+     bytes-wise `max(children, key=...)` tie-break.
+
+One XLA compile per pow2 (blocks, validators) bucket; the engine entry
+(`engine/fork_choice.py`) owns the padding (pad blocks parent-self-looped
+and unreal, pad validators vote -1 / balance 0).
+
+x64 mode is required: effective balances sum in exact int64 Gwei.
+"""
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+
+# Validator-lane chunk for the masked segment-sum: bounds the live
+# (V_chunk, B) mask so a 1M-validator registry never materializes a
+# (V, B) intermediate. Must divide every validator bucket >= itself.
+V_CHUNK = 4096
+
+
+def _ghost_head_impl(parent: jax.Array, root_words: jax.Array,
+                     ck_epochs: jax.Array, ck_rids: jax.Array,
+                     is_real: jax.Array, votes: jax.Array,
+                     balances: jax.Array, idx_scalars: jax.Array,
+                     ep_scalars: jax.Array) -> jax.Array:
+    """One store snapshot -> head block index (int32 scalar).
+
+    `parent` (B,) int32 parent indices (anchor and pads self-looped);
+    `root_words` (B, 8) uint32 big-endian root words; `ck_epochs` (B, 2)
+    int64 / `ck_rids` (B, 2) int32 per-block (justified, finalized)
+    checkpoint epochs + interned root ids; `is_real` (B,) bool;
+    `votes` (V,) int32 latest-message block index (-1 = none);
+    `balances` (V,) int64 effective Gwei; `idx_scalars` (4,) int32 =
+    [justified_idx, boost_idx (-1 = off), store_justified_rid,
+    store_finalized_rid]; `ep_scalars` (4,) int64 = [store_justified_epoch,
+    store_finalized_epoch, GENESIS_EPOCH, boost_weight]."""
+    b = parent.shape[0]
+    v = votes.shape[0]
+    idx = jnp.arange(b, dtype=jnp.int32)
+
+    justified_idx = idx_scalars[0]
+    boost_idx = idx_scalars[1]
+    store_just_rid = idx_scalars[2]
+    store_fin_rid = idx_scalars[3]
+    store_just_ep = ep_scalars[0]
+    store_fin_ep = ep_scalars[1]
+    genesis_ep = ep_scalars[2]
+    boost_weight = ep_scalars[3]
+
+    # 1. ancestor-or-self matrix by pointer doubling: after k steps anc
+    # covers all ancestors within distance 2^k, so log2(B) steps saturate
+    # any chain that fits the bucket (self-looped roots are fixpoints).
+    levels = (b - 1).bit_length() if b > 1 else 0
+
+    def double(_i, carry):
+        anc, jump = carry
+        anc = anc | jnp.take(anc, jump, axis=0)
+        return anc, jnp.take(jump, jump, axis=0)
+
+    anc, _ = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(levels), double,
+        (jnp.eye(b, dtype=jnp.bool_), parent))
+
+    # 2a. direct vote weight per block: chunked masked segment-sum
+    chunk = v if v < V_CHUNK else V_CHUNK
+
+    def seg_sum(k, acc):
+        off = k * jnp.int32(chunk)
+        vs = jax.lax.dynamic_slice(votes, (off,), (chunk,))
+        bs = jax.lax.dynamic_slice(balances, (off,), (chunk,))
+        mask = vs[:, None] == idx[None, :]  # (chunk, B); vote -1 never hits
+        return acc + jnp.sum(
+            jnp.where(mask, bs[:, None], jnp.int64(0)), axis=0)
+
+    direct = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(v // chunk), seg_sum,
+        jnp.zeros((b,), dtype=jnp.int64))
+
+    # 2b. subtree weight: W[c] = sum of direct votes over descendants-or-self
+    weight = jnp.sum(jnp.where(anc, direct[:, None], jnp.int64(0)), axis=0)
+
+    # 2c. proposer boost: every ancestor-or-self of the boost root gains
+    # the committee-fraction weight (one row gather; -1 disables)
+    boost_row = jnp.take(anc, jnp.maximum(boost_idx, jnp.int32(0)), axis=0)
+    weight = weight + jnp.where((boost_idx >= jnp.int32(0)) & boost_row,
+                                boost_weight, jnp.int64(0))
+
+    # 3a. filter_block_tree: a leaf is viable iff its head-state FFG
+    # checkpoints agree with the store's (GENESIS_EPOCH short-circuits,
+    # matching the spec's `== GENESIS_EPOCH or ==` disjunctions); an
+    # interior node is viable iff an agreeing leaf sits in its subtree.
+    child_of = ((parent[:, None] == idx[None, :])
+                & is_real[:, None] & (parent != idx)[:, None])
+    is_leaf = ~jnp.any(child_of, axis=0)
+    ok_just = ((store_just_ep == genesis_ep)
+               | ((ck_epochs[:, 0] == store_just_ep)
+                  & (ck_rids[:, 0] == store_just_rid)))
+    ok_fin = ((store_fin_ep == genesis_ep)
+              | ((ck_epochs[:, 1] == store_fin_ep)
+                 & (ck_rids[:, 1] == store_fin_rid)))
+    leaf_ok = is_leaf & is_real & ok_just & ok_fin
+    viable = jnp.any(anc & leaf_ok[:, None], axis=0)
+    filtered = (viable & is_real
+                & jnp.take(anc, justified_idx, axis=1))
+
+    # 3b. greedy head walk: from the justified root, step to the filtered
+    # child maximizing (weight, root) until childless. The lexicographic
+    # argmax refines a candidate mask — weight, then each big-endian root
+    # word — so ties break bytes-wise exactly like the spec's Root max.
+    def step(_i, head):
+        kids = (parent == head) & (idx != head) & filtered
+        has = jnp.any(kids)
+        m = kids & (weight == jnp.max(
+            jnp.where(kids, weight, jnp.int64(-1))))
+        for t in range(8):
+            wt = root_words[:, t]
+            m = m & (wt == jnp.max(jnp.where(m, wt, jnp.uint32(0))))
+        return jnp.where(has, jnp.argmax(m).astype(jnp.int32), head)
+
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(b), step,
+                             justified_idx.astype(jnp.int32))
+
+
+# (Q, ...) batched entry: one compile per (Q, B, V) pow2 bucket.
+ghost_head_bucket = jax.jit(jax.vmap(_ghost_head_impl))

@@ -32,10 +32,17 @@ FIREHOSE_PROFILE = {
     "firehose.ingest": dict(kind="raise", exc="transient"),
     "firehose.flush": dict(kind="raise", exc="transient"),
 }
+# sched.dispatch is the seam every work class crosses — the fork-choice
+# head lane included — so this drizzle exercises retry convergence on any
+# scheduler live during the run (transient: absorbed before the breaker).
+FORKCHOICE_PROFILE = {
+    "sched.dispatch": dict(kind="raise", exc="transient"),
+}
 PROFILES = {
     "engine": ENGINE_PROFILE,
     "firehose": FIREHOSE_PROFILE,
-    "full": {**ENGINE_PROFILE, **FIREHOSE_PROFILE},
+    "forkchoice": FORKCHOICE_PROFILE,
+    "full": {**ENGINE_PROFILE, **FIREHOSE_PROFILE, **FORKCHOICE_PROFILE},
 }
 
 

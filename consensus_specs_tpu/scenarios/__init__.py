@@ -25,10 +25,11 @@ from .history import ScenarioHistory, Segment, build_history  # noqa: F401
 from .lanes import (  # noqa: F401
     LaneResult,
     assert_converged,
+    device_head_checker,
     engine_lane,
     firehose_lane,
     oracle_lane,
     replay_history,
 )
 from .emit import emit_history, scenario_test_cases  # noqa: F401
-from .diff import diff_vector_trees  # noqa: F401
+from .diff import diff_checkpoints, diff_vector_trees  # noqa: F401

@@ -129,6 +129,59 @@ def _field_diff(rel: str, path_a: Path, path_b: Path,
     return out
 
 
+def _checkpoint_heads(cp: dict) -> dict:
+    """Every head claim a checkpoint carries: the reference `get_head`
+    root plus, when the lane ran with head_check, the device lane's."""
+    heads = {}
+    checks = cp.get("checks") or {}
+    head = checks.get("head") or {}
+    if "root" in head:
+        heads["reference"] = head["root"]
+    if "device_head" in cp:
+        heads["device"] = cp["device_head"]
+    return heads
+
+
+def diff_checkpoints(a: list, b: list) -> dict:
+    """Structured diff of two lane checkpoint transcripts.
+
+    Returns {"count": (len_a, len_b), "mismatches": [...], and — the
+    fork-choice lane's incident payload — "head_divergence": [...]}.
+    `mismatches` names the first divergent fields per checkpoint index
+    (the `_deep_diff` walk). `head_divergence` isolates disagreeing head
+    roots: across the two transcripts at the same index, and *within* a
+    single checkpoint when its `device_head` contradicts its own
+    reference head — so a wrong device head is attributed even when both
+    lanes mirror the same wrong value."""
+    mismatches: list = []
+    head_divergence: list = []
+    for i in range(max(len(a), len(b))):
+        ca = a[i] if i < len(a) else None
+        cb = b[i] if i < len(b) else None
+        if ca is None or cb is None:
+            mismatches.append(
+                {"index": i, "fields":
+                 [f"checkpoint[{i}]: missing on "
+                  f"{'left' if ca is None else 'right'}"]})
+            continue
+        heads = {}
+        for side, cp in (("a", ca), ("b", cb)):
+            for kind, root in _checkpoint_heads(cp).items():
+                heads[f"{side}.{kind}"] = root
+        if len(set(heads.values())) > 1:
+            head_divergence.append({
+                "index": i,
+                "epoch": ca.get("epoch", cb.get("epoch")),
+                "heads": heads,
+            })
+        if ca != cb:
+            fields: list = []
+            _deep_diff(ca, cb, f"checkpoint[{i}]", fields)
+            mismatches.append({"index": i, "fields": fields})
+    return {"count": (len(a), len(b)), "mismatches": mismatches,
+            "head_divergence": head_divergence}
+
+
 def diff_vector_trees(tree_a, tree_b) -> list:
     """Field-by-field diff of two vector trees; [] means identical."""
     root_a, root_b = _tests_root(tree_a), _tests_root(tree_b)

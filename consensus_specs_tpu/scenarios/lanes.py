@@ -85,9 +85,33 @@ def _null_router():
     yield
 
 
+def device_head_checker(spec, seg, *, registry=None):
+    """Per-segment device head checker: a ForkChoiceService over its own
+    sched "forkchoice" lane (breaker/retry isolated from the replay's
+    other scheduling), mirror synced incrementally per checkpoint. The
+    returned callable maps the segment's live store to the device head
+    root — the thing replay_history asserts equals `spec.get_head`."""
+    from ..forkchoice import ForkChoiceService
+    from ..sched import ForkChoiceWorkClass, Scheduler
+
+    service = ForkChoiceService(
+        scheduler=Scheduler(classes=[ForkChoiceWorkClass()],
+                            registry=registry),
+        registry=registry)
+    attached = []
+
+    def check(store) -> bytes:
+        if not attached:
+            service.attach(spec, store)
+            attached.append(True)
+        return service.head()
+
+    return check
+
+
 def replay_history(history: ScenarioHistory, *, name: str = "oracle",
                    epoch_router=None, attestation_gate=None,
-                   registry=None) -> LaneResult:
+                   registry=None, head_check=False) -> LaneResult:
     """Replay every segment's steps through a fresh store; one LaneResult.
 
     `epoch_router(spec)` — optional context-manager factory entered per
@@ -96,6 +120,14 @@ def replay_history(history: ScenarioHistory, *, name: str = "oracle",
     `gate(name, attestation)`, called before each gossip on_attestation
     (the firehose lane verifies through the pipeline here); it must raise
     to veto, and its verdict must agree with the oracle by construction.
+    `head_check` — truthy enables the per-checkpoint device fork-choice
+    assertion: every checkpoint also computes the head through the
+    forkchoice/ lane, records it as the checkpoint's `device_head`, and
+    a mismatch against the reference `get_head` dumps a flight-recorder
+    black box and fails the lane. Pass a `factory(spec, seg) ->
+    callable(store) -> bytes` to customize (True = device_head_checker).
+    Lanes compared by assert_converged must agree on this setting —
+    `device_head` participates in the bit-identical checkpoint dict.
     """
     from ..compiler import get_spec_with_overrides
     from ..crypto import bls
@@ -114,6 +146,11 @@ def replay_history(history: ScenarioHistory, *, name: str = "oracle",
                 seg.anchor_state.copy(), seg.anchor_block)
             gate = (attestation_gate(spec, seg)
                     if attestation_gate is not None else None)
+            checker = None
+            if head_check:
+                factory = (device_head_checker if head_check is True
+                           else head_check)
+                checker = factory(spec, seg, registry=reg)
             router = (epoch_router(spec) if epoch_router is not None
                       else _null_router())
             with router:
@@ -161,13 +198,29 @@ def replay_history(history: ScenarioHistory, *, name: str = "oracle",
                             head, checks = checks_snapshot(spec, store)
                             state_root = spec.hash_tree_root(
                                 store.block_states[head])
-                            result.checkpoints.append({
+                            cp = {
                                 "epoch": int(step["checkpoint"]),
                                 "fork": seg.fork,
                                 "head_state_root":
                                     "0x" + bytes(state_root).hex(),
                                 "checks": checks,
-                            })
+                            }
+                            if checker is not None:
+                                device = "0x" + checker(store).hex()
+                                cp["device_head"] = device
+                                if device != checks["head"]["root"]:
+                                    _flight.record(
+                                        "head_divergence", lane=name,
+                                        epoch=int(step["checkpoint"]),
+                                        reference=checks["head"]["root"],
+                                        device=device)
+                                    _flight.dump("head_divergence",
+                                                 meta={"lane": name})
+                                    raise AssertionError(
+                                        f"{name}: device head {device} != "
+                                        f"reference {checks['head']['root']}"
+                                        f" at epoch {step['checkpoint']}")
+                            result.checkpoints.append(cp)
                             reg.counter("scenario_checkpoints_total",
                                         lane=name).inc()
             if gate is not None and hasattr(gate, "finish"):
@@ -182,9 +235,11 @@ def replay_history(history: ScenarioHistory, *, name: str = "oracle",
 
 # -- lane: oracle -----------------------------------------------------------
 
-def oracle_lane(history: ScenarioHistory, *, registry=None) -> LaneResult:
+def oracle_lane(history: ScenarioHistory, *, registry=None,
+                head_check=False) -> LaneResult:
     """Pure-Python spec replay: the ground truth the others must match."""
-    return replay_history(history, name="oracle", registry=registry)
+    return replay_history(history, name="oracle", registry=registry,
+                          head_check=head_check)
 
 
 # -- lane: engine (chaos on) -------------------------------------------------
@@ -220,7 +275,8 @@ def _engine_epoch_router(spec):
 
 
 def engine_lane(history: ScenarioHistory, *, registry=None,
-                fault_seed=None, fault_profile: str = "engine") -> LaneResult:
+                fault_seed=None, fault_profile: str = "engine",
+                head_check=False) -> LaneResult:
     """Resident-engine replay with the long-horizon chaos drizzle live."""
     from ..engine import bridge
     from ..robustness.schedules import long_horizon_plan
@@ -232,7 +288,7 @@ def engine_lane(history: ScenarioHistory, *, registry=None,
         with plan.active():
             result = replay_history(
                 history, name="engine", epoch_router=_engine_epoch_router,
-                registry=registry)
+                registry=registry, head_check=head_check)
     finally:
         bridge.reset_device_breaker()
     result.extra["faults_fired"] = {
@@ -355,7 +411,7 @@ class _FirehoseGate:
 
 def firehose_lane(history: ScenarioHistory, *, registry=None,
                   adversarial: bool = True, fault_seed=None,
-                  chaos: bool = False) -> LaneResult:
+                  chaos: bool = False, head_check=False) -> LaneResult:
     """Streaming replay: gossip votes verified through the firehose/sched
     path before admission. `chaos=True` additionally drizzles transient
     faults over the ingest/flush seams (retried inside the pipeline)."""
@@ -373,9 +429,10 @@ def firehose_lane(history: ScenarioHistory, *, registry=None,
         with long_horizon_plan(seed, profile="firehose").active():
             return replay_history(history, name="firehose",
                                   attestation_gate=gate_factory,
-                                  registry=reg)
+                                  registry=reg, head_check=head_check)
     return replay_history(history, name="firehose",
-                          attestation_gate=gate_factory, registry=reg)
+                          attestation_gate=gate_factory, registry=reg,
+                          head_check=head_check)
 
 
 # -- convergence --------------------------------------------------------------
@@ -390,9 +447,18 @@ def assert_converged(results: list) -> None:
     try:
         _check_converged(results)
     except AssertionError as exc:
+        from .diff import diff_checkpoints
+
         lanes = [getattr(r, "name", "?") for r in results]
-        _flight.record("divergence", lanes=lanes, error=str(exc)[:500])
-        _flight.dump("scenario_divergence", meta={"lanes": lanes})
+        base = results[0].checkpoints if results else []
+        head_div = []
+        for other in results[1:]:
+            d = diff_checkpoints(base, other.checkpoints)
+            head_div.extend(d["head_divergence"])
+        _flight.record("divergence", lanes=lanes, error=str(exc)[:500],
+                       head_divergence=head_div[:16])
+        _flight.dump("scenario_divergence",
+                     meta={"lanes": lanes, "head_divergence": head_div[:16]})
         raise
 
 

@@ -17,6 +17,7 @@ from . import bucketing  # noqa: F401
 from .api import Handle, Request  # noqa: F401
 from .classes import (  # noqa: F401
     BlsWorkClass,
+    ForkChoiceWorkClass,
     KzgWorkClass,
     MerkleWorkClass,
     MsmWorkClass,

@@ -552,6 +552,52 @@ class MsmWorkClass(WorkClass):
                     f"mismatch on a {len(scalars)}-term MSM")
 
 
+class ForkChoiceWorkClass(WorkClass):
+    """Batched LMD-GHOST head selection: the fork-choice lane.
+
+    One kind, "head": payload = (StoreSnapshot,) — the gather-form store
+    view from forkchoice/mirror. The device path groups snapshots by
+    their pow2 (blocks, validators) bucket and answers each group in one
+    `engine/fork_choice.ghost_head_batch` launch; the degraded path is
+    the spec-shaped host oracle (`forkchoice/reference.host_head`),
+    bit-identical per the documented ancestor-equivalence. The result
+    row is the head's block index into the snapshot's own table (int32 —
+    note index 0, the anchor, is a legitimate falsy head: this class
+    never collapses, so the resolver's falsy-collapse reverify path
+    cannot misread it)."""
+
+    name = "forkchoice"
+    kinds = ("head",)
+    min_bucket = 1
+
+    def execute(self, requests: list) -> np.ndarray:
+        from ..engine.fork_choice import ghost_head_batch
+
+        return ghost_head_batch([r.payload[0] for r in requests])
+
+    def execute_degraded(self, requests: list) -> np.ndarray:
+        from ..forkchoice.reference import host_head
+
+        return np.asarray([host_head(r.payload[0]) for r in requests],
+                          dtype=np.int32)
+
+    def to_result(self, row):
+        return int(row)
+
+    def load(self, requests: list) -> tuple:
+        # units are head queries; each (blocks, validators) bucket pads
+        # its query axis independently (engine/fork_choice grouping)
+        by_bucket: dict = {}
+        for r in requests:
+            snap = r.payload[0]
+            key = (bucketing.pow2_bucket(max(1, snap.n_blocks), 8),
+                   bucketing.pow2_bucket(max(1, snap.n_validators), 64))
+            by_bucket[key] = by_bucket.get(key, 0) + 1
+        live = len(requests)
+        padded = sum(bucketing.pow2_bucket(k, 1) for k in by_bucket.values())
+        return live, padded
+
+
 def default_classes() -> list:
     return [BlsWorkClass(), KzgWorkClass(), MerkleWorkClass(),
-            MsmWorkClass()]
+            MsmWorkClass(), ForkChoiceWorkClass()]

@@ -119,3 +119,44 @@ def add_pow_block_step(parts, steps, pow_block):
 def finalize_steps(parts, steps):
     """Order: anchor parts, object parts, then steps.yaml last."""
     return parts + [("steps", "data", steps)]
+
+
+# --- pure store-update helpers ---------------------------------------------
+# The spec's on_attestation filtering and ancestor walk, extracted as pure
+# functions over plain mappings so the fork-choice lane (forkchoice/) can
+# reuse the exact reference semantics instead of copy-pasting them. The
+# step helpers above still drive the compiled spec directly, so vector
+# output is untouched.
+
+
+def latest_message_updates(latest_messages, attesting_indices, target_epoch):
+    """Pure twin of the spec's `update_latest_messages` admission filter
+    (phase0/fork-choice.md): of `attesting_indices`, the indices whose
+    latest message a new vote at `target_epoch` replaces — unseen
+    validators, or ones whose recorded message is from a strictly earlier
+    epoch. `latest_messages` maps index -> object with an `.epoch`
+    attribute (the spec's LatestMessage, or any namedtuple twin)."""
+    target_epoch = int(target_epoch)
+    return [i for i in attesting_indices
+            if i not in latest_messages
+            or target_epoch > int(latest_messages[i].epoch)]
+
+
+def ancestor_at_slot(blocks, root, slot):
+    """Pure twin of the spec's `get_ancestor` over any {root: block-like}
+    mapping (block-like = has `.slot` and `.parent_root`): walk parent
+    pointers while the block sits above `slot`; at or below it, the
+    current root is its own ancestor. Iterative where the spec recurses —
+    thousand-slot scenario chains would overflow Python's stack — and a
+    parent outside the mapping (or a self-parented anchor) terminates at
+    the current root where the spec would KeyError, which is what the
+    anchored/padded fork-choice mirrors rely on."""
+    slot = int(slot)
+    block = blocks[root]
+    while int(block.slot) > slot:
+        parent = block.parent_root
+        if parent == root or parent not in blocks:
+            return root
+        root = parent
+        block = blocks[root]
+    return root

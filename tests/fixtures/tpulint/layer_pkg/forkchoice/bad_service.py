@@ -1,0 +1,9 @@
+"""Seeded failure shape: a fork-choice service importing the device
+stack at module level — every jax-free consumer (the scenario lanes, the
+obs dump, the conformance runner) would drag jax in just by asking for
+the current head."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def head(snapshot):
+    return jax.device_get(snapshot)

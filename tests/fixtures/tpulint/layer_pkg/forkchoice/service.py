@@ -1,0 +1,19 @@
+"""Clean fork-choice service module: jax-free at module level, the
+device path deferred behind the sched work class — the forkchoice/
+charter (mirror bookkeeping, vote filtering, and head queries never
+touch the device stack directly; the kernel lives in ops/ and is reached
+only through dispatch)."""
+
+votes = {}
+
+
+def apply_vote(index, root):
+    votes[index] = root
+
+
+def head(snapshot, use_device=False):
+    if use_device:
+        from .. import ops  # deferred: only the dispatch path pays
+
+        return ops.head(snapshot)
+    return max(votes, default=0)

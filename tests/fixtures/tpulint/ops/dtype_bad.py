@@ -39,3 +39,16 @@ def level_walk(gindices, siblings, depth):
         return g >> jnp.int32(1), out
 
     return jax.lax.fori_loop(0, depth, step, (gindices, siblings))  # tpulint-expect: dtype-pin
+
+
+def head_walk(parent, weight, filtered, head0, b):
+    """The fork-choice head-walk shape (PR 17) with the bad spelling: the
+    block-count bound left bare traces s64 under x64 against the s32 head
+    carry the argmax refines."""
+    def step(i, head):
+        kids = (parent == head) & filtered
+        m = kids & (weight == weight.max())
+        return jax.lax.cond(m.any(), lambda: jnp.argmax(m).astype(jnp.int32),
+                            lambda: head)
+
+    return jax.lax.fori_loop(0, b, step, head0)  # tpulint-expect: dtype-pin

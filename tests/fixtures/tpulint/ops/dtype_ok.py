@@ -45,3 +45,15 @@ def level_walk(gindices, siblings, depth):
 
     return jax.lax.fori_loop(jnp.int32(0), jnp.int32(depth), step,
                              (gindices, siblings))
+
+
+def head_walk(parent, weight, filtered, head0, b):
+    """The sanctioned fork-choice head-walk spelling
+    (ops/forkchoice_jax._ghost_head_impl): both bounds pinned int32."""
+    def step(i, head):
+        kids = (parent == head) & filtered
+        m = kids & (weight == weight.max())
+        return jax.lax.cond(m.any(), lambda: jnp.argmax(m).astype(jnp.int32),
+                            lambda: head)
+
+    return jax.lax.fori_loop(jnp.int32(0), jnp.int32(b), step, head0)

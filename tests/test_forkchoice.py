@@ -1,0 +1,513 @@
+"""Device-resident fork choice: the batched LMD-GHOST head kernel pinned
+bit-identical against the spec-shaped host oracle and the compiled spec's
+`get_head`, the "forkchoice" sched lane's retry/breaker/degrade seam, the
+ForkChoiceService firehose subscription, and the three-lane scenario
+replay with per-checkpoint device-head assertions.
+
+Layers under test:
+  * ops/forkchoice_jax.py + engine/fork_choice.ghost_head_batch — kernel
+  * forkchoice/ — StoreMirror, reference.host_head, ForkChoiceService
+  * sched/classes.py ForkChoiceWorkClass kind="head" — batching seam
+  * firehose/pipeline.subscribe_verified — verified-batch consumer seam
+  * scenarios/lanes.py head_check + scenarios/diff.diff_checkpoints
+  * testlib/fork_choice.py pure helpers (the extracted spec semantics)
+"""
+import random
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.engine.fork_choice import ghost_head_batch
+from consensus_specs_tpu.forkchoice import (
+    ForkChoiceService,
+    StoreMirror,
+    host_head,
+)
+from consensus_specs_tpu.obs import metrics as obs_metrics
+from consensus_specs_tpu.robustness.faults import FaultPlan, FaultSpec
+from consensus_specs_tpu.robustness.retry import RetryPolicy
+from consensus_specs_tpu.scenarios import (
+    assert_converged,
+    build_history,
+    build_script,
+    diff_checkpoints,
+    engine_lane,
+    firehose_lane,
+    oracle_lane,
+)
+from consensus_specs_tpu.sched import ForkChoiceWorkClass, Request, Scheduler
+from consensus_specs_tpu.testlib.fork_choice import (
+    ancestor_at_slot,
+    latest_message_updates,
+)
+
+FAST_RETRY = RetryPolicy(max_attempts=4, base_delay=0.0, backoff=1.0,
+                         max_delay=0.0, jitter=0.0)
+SEED, EPOCHS = 1, 4
+GWEI_32 = 32_000_000_000
+
+
+@pytest.fixture(scope="module")
+def history():
+    return build_history(build_script(SEED, epochs=EPOCHS))
+
+
+# --- helpers -----------------------------------------------------------------
+
+
+def _root(rng) -> bytes:
+    return bytes(rng.randrange(256) for _ in range(32))
+
+
+def _rand_mirror(seed, nb=16, nv=48) -> StoreMirror:
+    """Seeded contested tree in a StoreMirror: random branching, mixed
+    per-block FFG checkpoints, partial vote participation, sometimes a
+    proposer boost, sometimes a non-genesis store justification."""
+    rng = random.Random(seed)
+    m = StoreMirror()
+    anchor = _root(rng)
+    anchor_ck = (0, anchor)
+    m.add_block(anchor, anchor, 0, justified=anchor_ck, finalized=anchor_ck)
+    roots, slots = [anchor], {anchor: 0}
+    for _ in range(nb - 1):
+        parent = roots[rng.randrange(len(roots))]
+        root = _root(rng)
+        slot = slots[parent] + rng.randrange(1, 3)
+        jc = anchor_ck if rng.random() < 0.8 else (1, roots[0])
+        fc = anchor_ck if rng.random() < 0.9 else (1, anchor)
+        m.add_block(root, parent, slot, justified=jc, finalized=fc)
+        roots.append(root)
+        slots[root] = slot
+    m.set_registry(np.full(nv, GWEI_32, dtype=np.int64))
+    for v in range(nv):
+        if rng.random() < 0.7:
+            m.set_vote(v, roots[rng.randrange(len(roots))])
+    if rng.random() < 0.5:
+        m.set_checkpoints((0, anchor), (0, anchor))
+    else:
+        m.set_checkpoints((1, anchor), (0, anchor))
+    if rng.random() < 0.5:
+        m.set_boost(roots[rng.randrange(len(roots))], 2 * GWEI_32)
+    return m
+
+
+def _fresh_sched(**kw):
+    kw.setdefault("retry_policy", FAST_RETRY)
+    return Scheduler(classes=[ForkChoiceWorkClass()], **kw)
+
+
+def _heads_via_sched(snaps, **kw):
+    sch = _fresh_sched(**kw)
+    handles = [sch.submit(Request(work_class="forkchoice", kind="head",
+                                  payload=(s,))) for s in snaps]
+    sch.drain()
+    return [h.result() for h in handles], sch
+
+
+# --- kernel vs host oracle ---------------------------------------------------
+
+
+def test_kernel_matches_host_oracle_random_trees():
+    """Batched device heads == spec-shaped host oracle across mixed
+    (blocks, validators) buckets in one launch set."""
+    snaps = []
+    for seed in range(48):
+        rng = random.Random(1000 + seed)
+        snaps.append(_rand_mirror(seed, nb=rng.randrange(1, 34),
+                                  nv=rng.randrange(1, 90)).snapshot())
+    device = ghost_head_batch(snaps)
+    for i, snap in enumerate(snaps):
+        assert int(device[i]) == host_head(snap), f"tree {i}"
+
+
+def _two_fork_mirror(weights=(3, 2), boost=None, tie=False):
+    """anchor -> {a, b} with `weights` validators voting each side; fixed
+    roots so tie-break assertions are deterministic."""
+    m = StoreMirror()
+    anchor = b"\x10" * 32
+    a, b = b"\xaa" * 32, b"\x0b" * 32  # a > b bytes-wise
+    ck = (0, anchor)
+    m.add_block(anchor, anchor, 0, justified=ck, finalized=ck)
+    m.add_block(a, anchor, 1, justified=ck, finalized=ck)
+    m.add_block(b, anchor, 1, justified=ck, finalized=ck)
+    nv = sum(weights)
+    m.set_registry(np.full(max(nv, 1), GWEI_32, dtype=np.int64))
+    v = 0
+    for root, count in zip((a, b), weights):
+        for _ in range(count):
+            m.set_vote(v, root)
+            v += 1
+    m.set_checkpoints((0, anchor), (0, anchor))
+    if boost is not None:
+        m.set_boost(boost, 2 * GWEI_32)
+    if tie:
+        pass
+    return m, anchor, a, b
+
+
+def test_weighted_fork_boost_and_tiebreak_edges():
+    # plain LMD majority
+    m, _, a, b = _two_fork_mirror(weights=(3, 2))
+    assert m.root_at(int(ghost_head_batch([m.snapshot()])[0])) == a
+    # proposer boost flips the lighter side (1-vote gap < 2*GWEI_32 boost)
+    m, _, a, b = _two_fork_mirror(weights=(3, 2), boost=b)
+    assert m.root_at(int(ghost_head_batch([m.snapshot()])[0])) == b
+    # exact tie: higher root bytes win (spec max(children, key=(w, root)))
+    m, _, a, b = _two_fork_mirror(weights=(2, 2))
+    assert a > b
+    assert m.root_at(int(ghost_head_batch([m.snapshot()])[0])) == a
+    # all-zero votes tie too
+    m, _, a, b = _two_fork_mirror(weights=(0, 0))
+    assert m.root_at(int(ghost_head_batch([m.snapshot()])[0])) == a
+    for m, *_ in (_two_fork_mirror(weights=(3, 2)),
+                  _two_fork_mirror(weights=(3, 2), boost=b),
+                  _two_fork_mirror(weights=(2, 2))):
+        snap = m.snapshot()
+        assert int(ghost_head_batch([snap])[0]) == host_head(snap)
+
+
+def test_ffg_filtering_prunes_disagreeing_leaves():
+    """A heavier branch whose leaf states disagree with the store's
+    justified checkpoint is filtered out (spec filter_block_tree); with
+    no viable leaf at all the head stays the justified root."""
+    m = StoreMirror()
+    anchor = b"\x01" * 32
+    good, bad = b"\x02" * 32, b"\x03" * 32
+    just_ck = (1, anchor)
+    m.add_block(anchor, anchor, 0, justified=just_ck, finalized=(0, anchor))
+    # leaf agreeing with the store's justified view
+    m.add_block(good, anchor, 1, justified=just_ck, finalized=(0, anchor))
+    # heavier leaf with a stale justified checkpoint
+    m.add_block(bad, anchor, 1, justified=(0, anchor), finalized=(0, anchor))
+    m.set_registry(np.full(4, GWEI_32, dtype=np.int64))
+    for v in range(4):
+        m.set_vote(v, bad)
+    m.set_checkpoints(just_ck, (0, anchor))
+    snap = m.snapshot()
+    assert m.root_at(host_head(snap)) == good
+    assert int(ghost_head_batch([snap])[0]) == host_head(snap)
+    # now make every leaf disagree: head falls back to the justified root
+    m2 = StoreMirror()
+    m2.add_block(anchor, anchor, 0, justified=just_ck, finalized=(0, anchor))
+    m2.add_block(bad, anchor, 1, justified=(0, anchor), finalized=(0, anchor))
+    m2.set_registry(np.full(2, GWEI_32, dtype=np.int64))
+    m2.set_vote(0, bad)
+    m2.set_checkpoints(just_ck, (0, anchor))
+    snap2 = m2.snapshot()
+    assert m2.root_at(host_head(snap2)) == anchor
+    assert int(ghost_head_batch([snap2])[0]) == host_head(snap2)
+
+
+# --- testlib pure helpers ----------------------------------------------------
+
+
+class _Msg:
+    def __init__(self, epoch):
+        self.epoch = epoch
+
+
+class _Blk:
+    def __init__(self, slot, parent_root):
+        self.slot = slot
+        self.parent_root = parent_root
+
+
+def test_latest_message_updates_filter():
+    lm = {1: _Msg(3), 2: _Msg(5)}
+    # unseen admitted, older/equal filtered, newer admitted
+    assert latest_message_updates(lm, [0, 1, 2, 3], 4) == [0, 1, 3]
+    assert latest_message_updates(lm, [1, 2], 3) == []
+    assert latest_message_updates({}, [7], 0) == [7]
+
+
+def test_ancestor_at_slot_walk():
+    blocks = {"a": _Blk(0, "a"), "b": _Blk(2, "a"), "c": _Blk(5, "b")}
+    assert ancestor_at_slot(blocks, "c", 5) == "c"
+    assert ancestor_at_slot(blocks, "c", 4) == "b"
+    assert ancestor_at_slot(blocks, "c", 2) == "b"
+    assert ancestor_at_slot(blocks, "c", 1) == "a"
+    # self-parented anchor terminates below its own slot
+    assert ancestor_at_slot({"x": _Blk(9, "x")}, "x", 3) == "x"
+
+
+# --- the sched lane ----------------------------------------------------------
+
+
+def test_sched_forkchoice_device_degraded_agree():
+    snaps = [_rand_mirror(s, nb=12 + s, nv=20 + s).snapshot()
+             for s in range(5)]
+    reqs = [Request(work_class="forkchoice", kind="head", payload=(s,))
+            for s in snaps]
+    cls = ForkChoiceWorkClass()
+    oracle = [host_head(s) for s in snaps]
+    assert [cls.to_result(r) for r in cls.execute(reqs)] == oracle
+    assert [cls.to_result(r) for r in cls.execute_degraded(reqs)] == oracle
+    heads, sch = _heads_via_sched(snaps)
+    assert heads == oracle
+    assert sch.breaker("forkchoice").state == "closed"
+
+
+def test_forkchoice_compile_pinned_one_per_bucket():
+    """One XLA compile per (blocks, validators) pow2 bucket, zero
+    recompiles on replay, exactly one more on a new bucket."""
+    from consensus_specs_tpu.obs.recompile import CompileTracker
+
+    kernel = "_ghost_head_impl"
+    tracker = CompileTracker(
+        registry=obs_metrics.MetricsRegistry()).install()
+    try:
+        def run(seeds, nb, nv):
+            snaps = [_rand_mirror(s, nb=nb, nv=nv).snapshot()
+                     for s in seeds]
+            heads = ghost_head_batch(snaps)
+            for snap, head in zip(snaps, heads):
+                assert int(head) == host_head(snap)
+
+        # B=128 / V=128: out of reach of every other test in this file
+        # (their trees stay under 64 blocks), so the pin is counted from
+        # a cold bucket no matter the execution order.
+        base = tracker.compiles(kernel)
+        run(range(3), 70, 100)    # bucket (B=128, V=128), Q=4
+        first = tracker.compiles(kernel) - base
+        assert first == 1
+        run(range(3, 6), 65, 90)  # same bucket, replay: zero recompiles
+        assert tracker.compiles(kernel) - base == first
+        run(range(3), 70, 150)    # new validator bucket (V=256): one more
+        assert tracker.compiles(kernel) - base == first + 1
+        assert tracker.distinct_shapes(kernel) == first + 1
+    finally:
+        tracker.uninstall()
+
+
+def test_chaos_sched_forkchoice_converges_bit_identical():
+    """Seeded raise + corrupt chaos at sched.dispatch: absorbed by retry
+    from intact snapshots, heads bit-identical, breaker closed."""
+    snaps = [_rand_mirror(100 + s, nb=10, nv=30).snapshot()
+             for s in range(4)]
+    oracle = [host_head(s) for s in snaps]
+    heads, sch = _heads_via_sched(snaps)
+    assert heads == oracle  # fault-free sanity
+    schedules = (
+        dict(kind="raise", at_calls=(1, 2), exc="transient"),
+        dict(kind="raise", at_calls=(1,), exc="xla"),
+        dict(kind="corrupt", at_calls=(1,), corruption="nan"),
+        dict(kind="corrupt", at_calls=(1,), corruption="truncate"),
+    )
+    for kw in schedules:
+        plan = FaultPlan(seed=17, sites={"sched.dispatch": FaultSpec(**kw)})
+        with plan.active():
+            heads, sch = _heads_via_sched(snaps)
+        assert heads == oracle
+        assert sch.breaker("forkchoice").state == "closed"
+        assert plan.fired_sites() == {"sched.dispatch"}
+
+
+def test_chaos_sched_forkchoice_hard_down_degrades_to_host():
+    """A hard-down dispatch exhausts retries, opens the forkchoice
+    breaker, and heads come from the host oracle — identical."""
+    snaps = [_rand_mirror(200 + s, nb=14, nv=25).snapshot()
+             for s in range(3)]
+    oracle = [host_head(s) for s in snaps]
+    plan = FaultPlan(seed=5, sites={
+        "sched.dispatch": FaultSpec(kind="raise", rate=1.0,
+                                    max_fires=FAST_RETRY.max_attempts,
+                                    exc="transient"),
+    })
+    with plan.active():
+        heads, sch = _heads_via_sched(snaps, failure_threshold=1)
+    assert heads == oracle
+    assert sch.breaker("forkchoice").state == "open"
+
+
+# --- the service -------------------------------------------------------------
+
+
+def test_service_direct_drive_votes_and_metrics():
+    reg = obs_metrics.MetricsRegistry()
+    service = ForkChoiceService(scheduler=_fresh_sched(registry=reg),
+                                registry=reg)
+    m = service.mirror
+    anchor, a, b = b"\x20" * 32, b"\xbb" * 32, b"\x2b" * 32
+    ck = (0, anchor)
+    m.add_block(anchor, anchor, 0, justified=ck, finalized=ck)
+    m.add_block(a, anchor, 1, justified=ck, finalized=ck)
+    m.add_block(b, anchor, 1, justified=ck, finalized=ck)
+    m.set_registry(np.full(4, GWEI_32, dtype=np.int64))
+    m.set_checkpoints(ck, ck)
+    assert service.apply_votes([0, 1, 2], 1, b) == [0, 1, 2]
+    assert service.head() == b
+    # an older-epoch vote for the other side must NOT move the messages
+    assert service.apply_votes([0, 1, 2], 0, a) == []
+    assert service.head() == b
+    # a newer-epoch majority flips the head
+    assert service.apply_votes([0, 1], 2, a) == [0, 1]
+    # 2 votes a vs 1 vote b: a wins (and a > b bytes-wise anyway)
+    assert service.head() == a
+    assert reg.counter_value("forkchoice_heads_total") == 3
+
+
+def test_service_subscribes_to_firehose_verified_batches():
+    """The verified-batch consumer seam: each sealed flush triggers one
+    head recompute and a head-lag observation per verified record; a
+    subscriber fault is counted, not propagated."""
+    import json
+
+    from consensus_specs_tpu.firehose.ingest import (
+        AttestationItem,
+        ClassifyError,
+    )
+    from consensus_specs_tpu.firehose.pipeline import (
+        AttestationFirehose,
+        FirehoseConfig,
+    )
+    from consensus_specs_tpu.parallel.gossip_driver import message_id
+    from consensus_specs_tpu.sched import BlsWorkClass
+
+    class _StubBls(BlsWorkClass):
+        def execute(self, requests):
+            return np.asarray([True] * len(requests), dtype=bool)
+
+        execute_degraded = execute
+
+    def classify(raw):
+        try:
+            d = json.loads(raw)
+            return AttestationItem(
+                msg_id=message_id(bytes(raw)), key=(0, d["c"], b"r"),
+                pubkeys=(b"\x01",), message=b"m", signature=b"\x02",
+                ssz=bytes(raw))
+        except Exception as exc:
+            raise ClassifyError(str(exc)) from exc
+
+    reg = obs_metrics.MetricsRegistry()
+    hose = AttestationFirehose(
+        classify,
+        config=FirehoseConfig(batch_attestations=1, max_pending=16,
+                              flush_deadline_s=0.0),
+        scheduler=Scheduler(classes=[_StubBls()], max_depth=1 << 30,
+                            registry=reg),
+        registry=reg, threaded=False)
+
+    service = ForkChoiceService(scheduler=_fresh_sched(registry=reg),
+                                registry=reg)
+    m = _rand_mirror(7, nb=10, nv=16)
+    service.mirror = m
+    expected = m.root_at(host_head(m.snapshot()))
+    seen = []
+    service.subscribe(hose)
+    hose.subscribe_verified(lambda records: seen.append(len(records)))
+    hose.subscribe_verified(lambda records: 1 / 0)  # faulty consumer
+
+    for c in range(3):
+        assert hose.offer(json.dumps({"c": c}).encode())
+    hose.drain(timeout_s=30.0)
+    assert seen and sum(seen) == 3
+    assert service.head() == expected
+    assert reg.counter_value("forkchoice_heads_total") >= 3
+    lag = reg.histogram("forkchoice_head_lag_seconds")
+    assert lag.count >= 3
+    assert reg.counter_value("firehose_subscriber_errors_total") >= 3
+
+
+# --- scenario replay: three lanes with the head check ------------------------
+
+
+def _harddown_checker(spec, seg, *, registry=None):
+    """device_head_checker variant whose lane opens its breaker on the
+    first exhausted retry budget (failure_threshold=1)."""
+    service = ForkChoiceService(
+        scheduler=Scheduler(classes=[ForkChoiceWorkClass()],
+                            retry_policy=FAST_RETRY, failure_threshold=1,
+                            registry=registry),
+        registry=registry)
+    attached = []
+
+    def check(store) -> bytes:
+        if not attached:
+            service.attach(spec, store)
+            attached.append(True)
+        return service.head()
+
+    return check
+
+
+def test_three_lanes_converge_with_device_head_checks(history):
+    """Every epoch checkpoint of every lane carries a device_head equal
+    to the reference get_head — and the three transcripts (including the
+    device heads) stay bit-identical. The engine lane runs the "full"
+    chaos profile, so sched.dispatch transients hit the head lane's own
+    dispatch and must converge via retry."""
+    o = oracle_lane(history, head_check=True)
+    e = engine_lane(history, fault_seed=7, fault_profile="full",
+                    head_check=True)
+    f = firehose_lane(history, chaos=True, fault_seed=SEED, head_check=True)
+    assert_converged([o, e, f])
+    assert o.checkpoints, "history produced no checkpoints"
+    for cp in o.checkpoints:
+        assert cp["device_head"] == cp["checks"]["head"]["root"]
+
+
+def test_head_check_hard_down_degrades_identically(history):
+    """Permanent sched.dispatch failure: every head query degrades to the
+    host oracle and the transcript (device_head included) still matches a
+    fault-free device run bit-for-bit."""
+    clean = oracle_lane(history, head_check=True)
+    plan = FaultPlan(seed=9, sites={
+        "sched.dispatch": FaultSpec(kind="raise", rate=1.0,
+                                    max_fires=1 << 30, exc="transient"),
+    })
+    with plan.active():
+        degraded = oracle_lane(history, head_check=_harddown_checker)
+    assert plan.fires("sched.dispatch") > 0
+    assert_converged([clean, degraded])
+
+
+def test_diff_checkpoints_reports_head_divergence():
+    cp = {"epoch": 3, "fork": "phase0", "head_state_root": "0xaa",
+          "checks": {"head": {"slot": 24, "root": "0x01"}},
+          "device_head": "0x01"}
+    assert diff_checkpoints([cp], [cp]) == {
+        "count": (1, 1), "mismatches": [], "head_divergence": []}
+    # cross-transcript divergence
+    other = {**cp, "checks": {"head": {"slot": 24, "root": "0x02"}},
+             "device_head": "0x02"}
+    d = diff_checkpoints([cp], [other])
+    assert d["head_divergence"] and d["head_divergence"][0]["index"] == 0
+    assert d["mismatches"]
+    # intra-checkpoint divergence: device head contradicts its own lane
+    wrong = {**cp, "device_head": "0x99"}
+    d = diff_checkpoints([wrong], [wrong])
+    assert d["head_divergence"][0]["heads"]["a.device"] == "0x99"
+    assert d["mismatches"] == []
+
+
+# --- the acceptance soak -----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_soak_thousand_slot_heads_bit_identical_all_lanes():
+    """Acceptance: a seeded ≥1,000-slot reorg-storm history where every
+    epoch checkpoint's device head equals the reference get_head in all
+    three lanes — with sched.dispatch chaos live in the engine lane
+    (retry convergence) — and a hard-down replay serves identical heads
+    from the host oracle with the breaker open."""
+    script = build_script(2026, epochs=126)
+    history = build_history(script)
+    o = oracle_lane(history, head_check=True)
+    e = engine_lane(history, fault_seed=2026, fault_profile="full",
+                    head_check=True)
+    f = firehose_lane(history, chaos=True, fault_seed=2026, head_check=True)
+    assert_converged([o, e, f])
+    assert o.slots >= 1000
+    assert o.reorgs >= 1
+    assert e.extra["faults_fired"]
+    for cp in o.checkpoints:
+        assert cp["device_head"] == cp["checks"]["head"]["root"]
+    plan = FaultPlan(seed=2027, sites={
+        "sched.dispatch": FaultSpec(kind="raise", rate=1.0,
+                                    max_fires=1 << 30, exc="transient"),
+    })
+    with plan.active():
+        harddown = oracle_lane(history, head_check=_harddown_checker)
+    assert plan.fires("sched.dispatch") > 0
+    assert_converged([o, harddown])

@@ -76,6 +76,9 @@ def test_dtype_pin_fixture():
     # the PR-15 multiproof level-walk pair: bare bounds flagged, pinned clean
     walk_lines = [i for i, l in enumerate(bad, 1) if "fori_loop(0, depth" in l]
     assert walk_lines and all((i, "dtype-pin") in expected for i in walk_lines)
+    # the PR-17 fork-choice head-walk pair: bare block-count bound flagged
+    head_lines = [i for i, l in enumerate(bad, 1) if "fori_loop(0, b," in l]
+    assert head_lines and all((i, "dtype-pin") in expected for i in head_lines)
 
 
 def test_donation_fixture():
@@ -102,12 +105,14 @@ def test_layering_fixture():
     assert "bad_stream.py" in by_file  # firehose/ module-level jax
     assert "bad_driver.py" in by_file  # scenarios/ module-level jax
     assert "bad_cache.py" in by_file  # proofs/ module-level jax
+    assert "bad_service.py" in by_file  # forkchoice/ module-level jax
     for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
                   "recompile.py",  # recompile: obs install-deferral pattern
                   "queue.py",  # sched: executor-deferral pattern
                   "stream.py",  # firehose: host-orchestrator pattern
                   "driver.py",  # scenarios: lane-deferral pattern
-                  "cache.py"):  # proofs: miss-path-deferral pattern
+                  "cache.py",  # proofs: miss-path-deferral pattern
+                  "service.py"):  # forkchoice: dispatch-deferral pattern
         assert clean not in by_file
 
 

@@ -105,6 +105,9 @@ def check_e2e_lane() -> int:
     rc = check_proof_lane(extra)
     if rc:
         return rc
+    rc = check_forkchoice_lane(extra)
+    if rc:
+        return rc
     return check_obs_snapshot()
 
 
@@ -224,6 +227,33 @@ def check_proof_lane(extra: dict) -> int:
           f"(warm={extra['proof_proofs_per_s_warm']}/s, "
           f"hit_ratio={extra['proof_cache_hit_ratio']}, "
           f"p99={extra['proof_p99_request_s']}s)", file=sys.stderr)
+    return 0
+
+
+def check_forkchoice_lane(extra: dict) -> int:
+    """Refuse a record without the fork-choice head lane: heads/s is the
+    write-side headline (every verified batch must produce a fresh head),
+    the head-lag p99 is the SLO series (verified -> head reflecting it,
+    from the lane's own histogram), and the flip count proves the soak
+    actually stormed — a contested tree whose head never moves measures
+    nothing. A bench that dropped the lane would keep reporting
+    verification throughput with no evidence the chain can still pick a
+    head at that rate."""
+    missing = [k for k in ("forkchoice_heads_per_s",
+                           "forkchoice_head_lag_p99_s",
+                           "forkchoice_head_flips",
+                           "forkchoice_vs_host_speedup")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"fork-choice head lane (missing {missing}); fix "
+              f"benches/forkchoice_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    print(f"# bench-probe: forkchoice lane present "
+          f"(heads={extra['forkchoice_heads_per_s']}/s, "
+          f"lag_p99={extra['forkchoice_head_lag_p99_s']}s, "
+          f"flips={extra['forkchoice_head_flips']})", file=sys.stderr)
     return 0
 
 
