@@ -170,12 +170,15 @@ endif
 # Static gate: compile-check + AST lint (unused imports, import shadowing,
 # mutable defaults, tuple asserts, bare excepts) + tpulint (JAX hot-path
 # invariants: jit purity, dtype pinning, donation aliasing, import layering,
-# scatter bans — see BASELINE.md). The reference's flake8+mypy role
-# (linter.ini) — those tools are not in this image.
+# scatter bans, lock discipline, guarded fields, thread escapes — see
+# BASELINE.md). The reference's flake8+mypy role (linter.ini) — those tools
+# are not in this image. --max-seconds 30 is the runtime ratchet: the
+# interprocedural fixpoints must stay a sub-minute gate as the tree grows
+# (per-rule cost is visible via `tpulint --json` timings_s).
 lint: pyspec
 	$(PYTHON) tools/lint.py
 	$(PYTHON) tools/typegate.py
-	$(PYTHON) tools/tpulint.py consensus_specs_tpu --baseline tpulint_baseline.json
+	$(PYTHON) tools/tpulint.py consensus_specs_tpu --baseline tpulint_baseline.json --max-seconds 30
 	$(PYTHON) tools/tpulint.py --self-test
 
 # Inner-loop lint: full interprocedural analysis (the call graph needs every

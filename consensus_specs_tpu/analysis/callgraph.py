@@ -139,7 +139,11 @@ class CallGraph:
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "__future__":
                     continue
-                base = (_resolve_relative(mod.name, node.level, node.module)
+                # in a package __init__, level-1 imports resolve against the
+                # package itself (its dotted name), not its parent
+                eff_level = (node.level - 1
+                             if mod.path.name == "__init__.py" else node.level)
+                base = (_resolve_relative(mod.name, eff_level, node.module)
                         if node.level else (node.module or ""))
                 for alias in node.names:
                     raw = f"{base}.{alias.name}" if base else alias.name

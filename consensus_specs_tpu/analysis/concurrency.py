@@ -1,0 +1,309 @@
+"""tpulint v3: the concurrency rules — lock-order, guarded-field, thread-escape.
+
+All three consume the ConcurrencyModel (threads.py) via `ctx.concurrency`.
+The guarded-field and thread-escape rules are scoped to the five threaded
+service planes (`firehose/`, `sched/`, `forkchoice/`, `obs/`, `robustness/`);
+lock-order runs globally because a deadlock cycle is a property of the whole
+acquisition graph, not of any one package.
+
+Benign patterns are encoded as RULE KNOWLEDGE, not suppressions — each one
+names a shipped idiom and states the safety argument, so a reader of a
+finding knows exactly which exemption a clean access rode through:
+
+  B1 init-publication   writes confined to __init__/__post_init__/__new__
+                        happen-before any thread sees the object (the Thread
+                        is started after construction).
+  B2 atomic publish     every non-init write is a plain whole-attribute
+                        store: a single STORE_ATTR is atomic under the GIL,
+                        so racing readers see either the old or the new
+                        value, never a torn one (Handle._value, the
+                        breaker's `state` string, `_seal` flips).
+  B3 monotonic reads    all writes are locked and additive-only (`+=` /
+                        plain stores, no `-=`, no container ops): an
+                        unlocked read observes a momentarily-stale but
+                        valid value (Counter.value, Gauge.value).
+  B4 borrowed-lock      a class whose every lock is handed in by its owner
+     instruments        and whose every write is under that lock is an
+                        internally-locked instrument; unlocked readers get
+                        B3-style staleness at worst (registry metrics).
+  B5 check-then-lock    an unlocked read that lexically precedes a locked
+                        access of the same field in the same function is
+                        the optimistic half of a double-checked pattern;
+                        the locked recheck is the authority
+                        (MetricsRegistry.counter's fast path).
+
+The planted-race fixture (tests/fixtures/tpulint/concurrency/) rides none
+of these and must stay flagged; the dynamic stress harness in
+tests/test_tpulint_concurrency.py proves the same race loses updates for
+real. Known limitation, stated in threads.py: lock ALIASING is not tracked,
+so a cycle woven through a borrowed lock under two names can be missed.
+"""
+from __future__ import annotations
+
+from .core import Finding, path_matches
+from .threads import lock_name
+
+_SCOPE = ("firehose/", "sched/", "forkchoice/", "obs/", "robustness/")
+
+
+def _in_scope(rel: str) -> bool:
+    return any(path_matches(rel, p) for p in _SCOPE)
+
+
+class LockOrderRule:
+    id = "lock-order"
+    severity = "warning"
+    doc = ("lock acquisitions must follow a consistent global order: a cycle "
+           "in the acquired-while-holding graph (including cross-module "
+           "call chains) is a potential deadlock; acquiring a non-reentrant "
+           "Lock while already holding it self-deadlocks")
+
+    def check_context(self, ctx) -> list[Finding]:
+        cm = ctx.concurrency
+        findings: list[Finding] = []
+        # edge set: (held_lock, acquired_lock) -> first acquire site
+        edges: dict = {}
+        for acq in cm.acquires:
+            held = acq.held | cm.entry_locks.get(acq.func, frozenset())
+            target = acq.decl.underlying
+            # self-acquisition of a non-reentrant lock: immediate deadlock
+            if target in held and not acq.decl.reentrant:
+                findings.append(Finding(
+                    path=acq.module.rel, line=acq.line, rule=self.id,
+                    severity=self.severity,
+                    message=(f"acquiring non-reentrant lock "
+                             f"{lock_name(target)} while already holding it "
+                             f"(in {acq.func.split(':')[-1]}) deadlocks"),
+                    hint=("split a `_locked` variant of the callee, or make "
+                          "the lock an RLock if re-entry is intended"),
+                ))
+                continue
+            for h in held:
+                if h != target:
+                    edges.setdefault((h, target), acq)
+            # nested acquisitions through calls made while holding `target`
+            for e in cm._out_edges.get(acq.func, []):
+                if target not in e.held:
+                    continue
+                for inner in cm.transitive_acquires.get(e.callee, ()):  # noqa: B007
+                    if inner != target:
+                        edges.setdefault((target, inner), acq)
+        # also: call edges where the caller holds H and the callee
+        # transitively acquires A give H -> A, the cross-module chains
+        for e in cm.edges:
+            held = e.held | cm.entry_locks.get(e.caller, frozenset())
+            if not held:
+                continue
+            for inner in cm.transitive_acquires.get(e.callee, ()):
+                for h in held:
+                    if h != inner and (h, inner) not in edges:
+                        edges[(h, inner)] = _SiteProxy(e.module, e.line,
+                                                       e.caller)
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _cycles(self, edges: dict) -> list[Finding]:
+        graph: dict = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        seen_cycles: set = set()
+        findings: list[Finding] = []
+        # DFS from every node; report each distinct cycle (as a frozenset of
+        # locks) once, anchored at each edge's acquire site
+        for start in sorted(graph, key=str):
+            stack = [(start, (start,))]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(graph.get(node, ()), key=str):
+                    if nxt == start:
+                        cyc = frozenset(path)
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        order = " -> ".join(lock_name(p) for p in path)
+                        for i, cur in enumerate(path):
+                            dst = path[(i + 1) % len(path)]
+                            site = edges.get((cur, dst))
+                            if site is None:
+                                continue
+                            findings.append(Finding(
+                                path=site.module.rel, line=site.line,
+                                rule=self.id, severity=self.severity,
+                                message=(f"lock-order cycle {order} -> "
+                                         f"{lock_name(start)}: "
+                                         f"{lock_name(cur)} is acquired "
+                                         f"while holding it elsewhere in "
+                                         f"the cycle (potential deadlock)"),
+                                hint=("pick one global acquisition order "
+                                      "and release before calling into the "
+                                      "other plane"),
+                            ))
+                    elif nxt not in path and len(path) < 8:
+                        stack.append((nxt, path + (nxt,)))
+        return findings
+
+
+class _SiteProxy:
+    __slots__ = ("module", "line", "func")
+
+    def __init__(self, module, line, func):
+        self.module, self.line, self.func = module, line, func
+
+
+class GuardedFieldRule:
+    id = "guarded-field"
+    severity = "warning"
+    doc = ("a mutable instance attribute shared across thread roots must be "
+           "accessed under the lock that dominates its writes; benign "
+           "patterns (init-publication, GIL-atomic publish stores, "
+           "locked monotonic counters, borrowed-lock instruments, "
+           "check-then-lock fast paths) are encoded in the rule")
+
+    def check_context(self, ctx) -> list[Finding]:
+        cm = ctx.concurrency
+        by_field: dict = {}
+        for a in cm.accesses:
+            if not _in_scope(a.module.rel):
+                continue
+            by_field.setdefault((a.cls.key, a.attr), []).append(a)
+        findings: list[Finding] = []
+        for (cls_key, attr), accs in sorted(by_field.items()):
+            findings.extend(self._check_field(cm, cls_key, attr, accs))
+        return findings
+
+    def _check_field(self, cm, cls_key, attr, accs) -> list[Finding]:
+        live = [a for a in accs if not a.in_init]
+        writes = [a for a in live if a.kind == "write"]
+        # B1: init-publication — all writes in __init__ happen-before the
+        # thread starts, so however many roots READ the field, it is
+        # effectively immutable shared state
+        if not writes:
+            return []
+        # shared across roots? union of labels over live accesses must span
+        # at least two roots, one of them a thread root
+        labels: set = set()
+        for a in live:
+            labels |= cm.func_labels(a.func)
+        if not (len(labels) >= 2 and any(l.startswith("thread:")
+                                         for l in labels)):
+            return []
+        # B2: atomic publish slot — every non-init write is a whole-attr store
+        if writes and all(a.op == "store" for a in writes):
+            return []
+        held_per = {id(a): cm.effective_held(a) for a in live}
+        # dominating guard: a lock held at EVERY live access -> clean
+        guard = None
+        for a in live:
+            h = held_per[id(a)]
+            guard = h if guard is None else (guard & h)
+        if guard:
+            return []
+        all_writes_locked = bool(writes) and all(held_per[id(a)]
+                                                 for a in writes)
+        monotonic = (all_writes_locked
+                     and all(a.op in ("aug-add", "store") for a in writes)
+                     and any(a.op == "aug-add" for a in writes))
+        borrowed = (all_writes_locked
+                    and cm.classes[cls_key].borrowed_locks_only())
+        # candidate lock for the message: intersection over locked writes
+        cand = None
+        for a in writes:
+            h = held_per[id(a)]
+            if not h:
+                continue
+            cand = h if cand is None else (cand & h)
+        cand_name = lock_name(sorted(cand, key=str)[0]) if cand else None
+        # per-function lexical map for B5 (check-then-lock)
+        locked_lines: dict = {}
+        for a in live:
+            if held_per[id(a)]:
+                fl = locked_lines.setdefault(a.func, [])
+                fl.append(a.line)
+        findings: list[Finding] = []
+        seen_lines: set = set()
+        cls_name = cls_key.split(":")[-1]
+        for a in sorted(live, key=lambda x: (x.module.rel, x.line)):
+            if held_per[id(a)]:
+                continue
+            if a.kind == "read" and (monotonic or borrowed):
+                continue  # B3 / B4
+            lf = locked_lines.get(a.func, ())
+            if a.kind == "read" and any(a.line < ln for ln in lf):
+                continue  # B5: optimistic read before the locked recheck
+            if (a.module.rel, a.line) in seen_lines:
+                continue
+            seen_lines.add((a.module.rel, a.line))
+            what = "write to" if a.kind == "write" else "read of"
+            where = (f"under {cand_name}" if cand_name
+                     else "under a consistent lock")
+            roots = sorted(l for l in labels if l.startswith("thread:"))
+            root_desc = roots[0].split(":", 1)[1].split(":")[-1] if roots \
+                else "a thread root"
+            findings.append(Finding(
+                path=a.module.rel, line=a.line, rule=self.id,
+                severity=self.severity,
+                message=(f"unguarded {what} {cls_name}.{attr}: the field is "
+                         f"reached from thread root {root_desc} and from "
+                         f"other roots, but this access holds no lock"),
+                hint=(f"guard every access {where}, or make the shared "
+                      f"state a frozen snapshot handed off whole"),
+            ))
+        return findings
+
+
+class ThreadEscapeRule:
+    id = "thread-escape"
+    severity = "warning"
+    doc = ("an object handed to a thread target (or stored on a service "
+           "that owns a thread root) must be frozen, internally "
+           "synchronized, or have every mutating method lock-guarded — "
+           "the StoreSnapshot pattern")
+
+    def check_context(self, ctx) -> list[Finding]:
+        cm = ctx.concurrency
+        findings: list[Finding] = []
+        audited: set = set()
+
+        def audit(cls_key, module, line, via) -> None:
+            info = cm.classes.get(cls_key)
+            if info is None:
+                return
+            if (cls_key, module.rel, line) in audited:
+                return
+            audited.add((cls_key, module.rel, line))
+            if info.frozen:
+                return
+            bad = cm.unguarded_mutators(cls_key)
+            if not bad:
+                return
+            name, mline = sorted(bad.items())[0]
+            findings.append(Finding(
+                path=module.rel, line=line, rule=self.id,
+                severity=self.severity,
+                message=(f"{info.name} escapes to another thread ({via}) "
+                         f"but {info.name}.{name} (line {mline}) mutates "
+                         f"state without a lock"),
+                hint=("freeze the object (frozen dataclass / StoreSnapshot), "
+                      "or guard every mutating method with the object's "
+                      "own lock"),
+            ))
+
+        for esc in cm.escapes:
+            if not _in_scope(esc.module.rel):
+                continue
+            audit(esc.cls_key, esc.module, esc.line, esc.via)
+        # attributes of classes that own a thread root are shared state too
+        for cls_key in sorted(cm.thread_rooted_classes()):
+            info = cm.classes.get(cls_key)
+            if info is None or not _in_scope(info.module.rel):
+                continue
+            for attr, t in sorted(info.attr_types.items()):
+                if t[0] not in ("inst", "coll"):
+                    continue
+                target = cm.classes.get(t[1])
+                if target is None:
+                    continue
+                audit(t[1], info.module, info.node.lineno,
+                      f"stored on {info.name}.{attr}, which owns a "
+                      f"thread root")
+        return findings

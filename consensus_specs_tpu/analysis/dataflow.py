@@ -817,6 +817,16 @@ class AnalysisContext:
         self.mods = mods
         self.graph = CallGraph.build(mods)
         self.engine = DataflowEngine.build(mods, self.graph)
+        self._concurrency = None
+
+    @property
+    def concurrency(self):
+        """Lazy ConcurrencyModel — only the v3 rules pay for the lock/thread
+        fixpoints, so `--rules jit-purity` stays as cheap as it was."""
+        if self._concurrency is None:
+            from .threads import ConcurrencyModel
+            self._concurrency = ConcurrencyModel(self.mods, self.graph)
+        return self._concurrency
 
 
 def _is_literal(node: ast.AST) -> bool:

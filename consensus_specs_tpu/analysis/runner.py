@@ -19,9 +19,11 @@ only exists after filtering).
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .concurrency import GuardedFieldRule, LockOrderRule, ThreadEscapeRule
 from .core import Finding, Module, collect_modules
 from .dataflow import AnalysisContext
 from .donation import DonationAliasRule
@@ -45,6 +47,9 @@ ALL_RULES = (
     DonationFlowRule(),
     SeamCoverageRule(),
     HostSyncRule(),
+    LockOrderRule(),
+    GuardedFieldRule(),
+    ThreadEscapeRule(),
     StaleSuppressionRule(),
 )
 
@@ -62,16 +67,19 @@ class AnalysisResult:
     findings: list[Finding] = field(default_factory=list)
     suppressed: int = 0
     file_count: int = 0
+    timings_s: dict = field(default_factory=dict)
 
     @property
     def errors(self) -> list[Finding]:
         return [f for f in self.findings if f.severity == "error"]
 
 
-def run_rules(mods: list[Module], rules=ALL_RULES) -> tuple[list[Finding], int]:
+def run_rules(mods: list[Module], rules=ALL_RULES,
+              timings: dict | None = None) -> tuple[list[Finding], int]:
     raw: list[Finding] = []
     ctx = None
     for rule in rules:
+        t0 = time.perf_counter()
         check_module = getattr(rule, "check_module", None)
         if check_module is not None:
             for mod in mods:
@@ -82,8 +90,15 @@ def run_rules(mods: list[Module], rules=ALL_RULES) -> tuple[list[Finding], int]:
         check_context = getattr(rule, "check_context", None)
         if check_context is not None:
             if ctx is None:
+                tc = time.perf_counter()
                 ctx = AnalysisContext(mods)
+                if timings is not None:
+                    timings["analysis-context"] = time.perf_counter() - tc
+                t0 = time.perf_counter()  # context build billed separately
             raw.extend(check_context(ctx))
+        if timings is not None:
+            timings[rule.id] = (timings.get(rule.id, 0.0)
+                                + time.perf_counter() - t0)
 
     by_rel = {m.rel: m for m in mods}
     kept: list[Finding] = []
@@ -124,8 +139,9 @@ def analyze_paths(paths: list[str | Path], rules=ALL_RULES) -> AnalysisResult:
         collected, syntax_errors = collect_modules(Path(p))
         mods.extend(collected)
         findings.extend(syntax_errors)  # never suppressible
-    kept, suppressed = run_rules(mods, rules)
+    timings: dict = {}
+    kept, suppressed = run_rules(mods, rules, timings=timings)
     findings.extend(kept)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return AnalysisResult(findings=findings, suppressed=suppressed,
-                          file_count=len(mods))
+                          file_count=len(mods), timings_s=timings)

@@ -1,0 +1,978 @@
+"""Concurrency model: locks, thread roots, and field accesses per class.
+
+PRs 9/13/17 made the service planes genuinely concurrent — the firehose's
+double-buffered flusher thread, `subscribe_verified` callbacks firing from
+the flush worker, ForkChoiceService recomputing heads off-thread — and the
+v3 rules (lock-order, guarded-field, thread-escape) all need the same four
+interprocedural facts this module computes once per run:
+
+  * the **lock model**: every `threading.Lock/RLock/Condition` attribute
+    per class (and module-level locks), with `Condition(self._lock)`
+    resolving to the identity of its UNDERLYING lock, so waiting on
+    `self._room` and holding `self._lock` are the same exclusion;
+  * **held-lock regions**: for every statement, which locks are held —
+    lexically (`with self._lock:`) plus an *entry-lock* fixpoint: a private
+    helper whose every in-scan call site holds L runs with L held (the
+    "caller holds self._lock" docstring contract, proved instead of
+    trusted). Public callables keep the intersection of their in-scan call
+    sites — the ambient-discipline assumption: external callers are taken
+    to follow the same protocol the package itself does; in-scan
+    violations are what the rules detect;
+  * **thread roots**: targets handed to `threading.Thread(...)`, callbacks
+    registered through `subscribe*`/`register*` seams, and everything they
+    transitively reach (which is how the sched flush entry points inherit
+    the firehose worker's thread label). Each function carries the set of
+    root labels that reach it; a field touched under two different labels
+    is shared across threads;
+  * **field accesses**: every `self.attr` read/write in a class's own
+    methods, including container mutations (`self._q.append`, subscript
+    stores, `del`), each stamped with its effective held-lock set.
+
+Method calls are resolved by a concurrency-local type layer the base
+CallGraph deliberately lacks: `self.m()` binds within the class;
+`self.attr.m()` / `local.m()` resolve through inferred types (constructor
+assignments, `__init__` param annotations, module globals, container
+element types, return annotations). Anything ambiguous stays unresolved
+and the rules under-approximate — the same stance as every other tpulint
+pass. Accesses through non-self references (`entry.members` on a local)
+are deliberately NOT tracked: the scheduler's queue-swap hand-off
+transfers exclusive ownership of popped entries, and attributing those
+accesses would flag the two shipped (correct) thread shapes.
+
+Known limitation, stated rather than hidden: borrowed locks (a Lock passed
+into a constructor, the registry-instrument pattern) keep their per-class
+identity — aliasing is not tracked, so a deadlock woven through an aliased
+pair would be missed. Stdlib-ast only, jax-free, like the rest of the
+analysis core.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import Module
+from .callgraph import CallGraph, _FUNC_NODES
+
+# Container methods treated as MUTATIONS of the field they are called on.
+MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popitem", "clear",
+    "update", "setdefault", "add", "discard", "sort", "reverse",
+    "appendleft", "popleft", "put", "put_nowait",
+})
+
+# threading attributes that denote a lock-like object (with-able exclusion).
+_LOCK_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+# threading objects that are internally synchronized or thread-identity
+# helpers: fields holding one are skipped by the access tracker.
+_SYNC_TYPES = frozenset({
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Thread", "Timer", "local",
+})
+
+_INIT_METHODS = frozenset({"__init__", "__post_init__", "__new__"})
+# annotation heads that denote a container OF the (last) element type
+_CONTAINER_NAMES = frozenset({
+    "dict", "Dict", "defaultdict", "OrderedDict", "list", "List",
+    "set", "Set", "frozenset", "FrozenSet", "deque", "Deque",
+    "tuple", "Tuple", "Sequence", "Iterable", "Mapping", "MutableMapping",
+})
+# dunders that are ordinary public entry points in practice
+_PUBLIC_DUNDERS = frozenset({
+    "__enter__", "__exit__", "__call__", "__iter__", "__next__",
+    "__len__", "__contains__", "__repr__",
+})
+
+_MAX_PASSES = 30
+
+
+# -- identities ---------------------------------------------------------------
+
+# LockId: ("attr", class_key, attr_name) | ("global", module_name, var_name)
+
+
+def lock_name(ident: tuple) -> str:
+    if ident[0] == "attr":
+        cls = ident[1].split(":")[-1]
+        return f"{cls}.{ident[2]}"
+    return f"{ident[1]}:{ident[2]}"
+
+
+@dataclass
+class LockDecl:
+    ident: tuple
+    kind: str            # "lock" | "rlock" | "condition"
+    underlying: tuple    # == ident except Condition(self._x) -> ident of _x
+    borrowed: bool       # assigned from a parameter (externally owned)
+    line: int = 0
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "rlock"
+
+
+@dataclass
+class ClassInfo:
+    key: str                       # "<module>:<ClassName>"
+    module: Module
+    node: ast.ClassDef
+    methods: dict = field(default_factory=dict)   # name -> ast def node
+    locks: dict = field(default_factory=dict)     # attr -> LockDecl
+    attr_types: dict = field(default_factory=dict)  # attr -> ("inst"|"coll", key)
+    frozen: bool = False
+    is_dataclass: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.key.split(":")[-1]
+
+    def borrowed_locks_only(self) -> bool:
+        return bool(self.locks) and all(d.borrowed for d in self.locks.values())
+
+
+@dataclass
+class FuncNode:
+    key: str                 # "<mod>:<func>" or "<mod>:<Class>.<method>"
+    module: Module
+    node: ast.AST
+    cls: Optional[ClassInfo]
+    name: str
+
+    @property
+    def is_init(self) -> bool:
+        return self.name in _INIT_METHODS
+
+    @property
+    def is_public(self) -> bool:
+        return (not self.name.startswith("_")
+                or self.name in _PUBLIC_DUNDERS)
+
+
+@dataclass
+class FieldAccess:
+    cls: ClassInfo
+    attr: str
+    func: str                # FuncNode key containing the access
+    module: Module
+    line: int
+    kind: str                # "read" | "write"
+    op: str                  # "load"|"store"|"aug-add"|"aug"|"subscript"|"mutcall"|"del"
+    held: frozenset          # lexically held lock idents at the access
+    in_init: bool
+
+
+@dataclass
+class CallEdge:
+    caller: str
+    callee: str
+    held: frozenset
+    module: Module
+    line: int
+
+
+@dataclass
+class Acquire:
+    func: str
+    decl: LockDecl
+    held: frozenset          # held BEFORE this acquisition (lexical only)
+    module: Module
+    line: int
+
+
+@dataclass
+class ThreadRoot:
+    func: str                # FuncNode key of the root callable
+    kind: str                # "thread" | "callback"
+    module: Module
+    line: int
+
+    @property
+    def label(self) -> str:
+        return f"thread:{self.func}"
+
+
+@dataclass
+class EscapeSite:
+    module: Module
+    line: int
+    cls_key: str             # class of the escaping object
+    via: str                 # "thread-target" | "thread-arg" | "service-attr"
+    detail: str = ""
+
+
+# -- small AST helpers --------------------------------------------------------
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _threading_aliases(mod: Module) -> dict:
+    """local name -> threading member ('*' for a module alias)."""
+    out: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "threading":
+                    out[alias.asname or "threading"] = "*"
+        elif isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+class ConcurrencyModel:
+    """Built lazily once per run (AnalysisContext.concurrency)."""
+
+    def __init__(self, mods: list[Module], graph: CallGraph) -> None:
+        self.mods = mods
+        self.graph = graph
+        self.classes: dict[str, ClassInfo] = {}
+        self.funcs: dict[str, FuncNode] = {}
+        self.accesses: list[FieldAccess] = []
+        self.edges: list[CallEdge] = []
+        self.acquires: list[Acquire] = []
+        self.roots: list[ThreadRoot] = []
+        self.escapes: list[EscapeSite] = []
+        self.module_locks: dict = {}     # (mod, name) -> LockDecl
+        self.module_globals: dict = {}   # (mod, name) -> ("inst", class_key)
+        self._threading: dict = {}       # mod name -> alias map
+        self._class_by_local: dict = {}  # (mod, local name) -> class_key
+        self._decls: dict = {}           # ident -> LockDecl (canonical)
+        self._build()
+        # computed facts
+        self.entry_locks: dict[str, frozenset] = {}
+        self.labels: dict[str, set] = {}
+        self._in_edges: dict[str, list[CallEdge]] = {}
+        self._out_edges: dict[str, list[CallEdge]] = {}
+        self._acq_by_func: dict[str, list[Acquire]] = {}
+        self._solve()
+
+    # -- phase 1: indexes ----------------------------------------------------
+
+    def _build(self) -> None:
+        for mod in self.mods:
+            self._threading[mod.name] = _threading_aliases(mod)
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    self._index_class(mod, stmt)
+        for mod in self.mods:
+            self._index_module_scope(mod)
+        # class-name local bindings (imports) need every class indexed first
+        for mod in self.mods:
+            local: dict = {}
+            for stmt in mod.tree.body:
+                if isinstance(stmt, ast.ClassDef):
+                    local[stmt.name] = f"{mod.name}:{stmt.name}"
+            for alias, binding in self.graph.imports.get(mod.name, {}).items():
+                if binding[0] == "func":
+                    key = self._chase_class(binding[1], binding[2])
+                    if key is not None:
+                        local[alias] = key
+            for name, key in local.items():
+                self._class_by_local[(mod.name, name)] = key
+        # second pass over classes: attribute types + locks need class index
+        for info in self.classes.values():
+            self._infer_class_attrs(info)
+        for mod in self.mods:
+            self._infer_module_globals(mod)
+        # third pass: walk every function body
+        for info in list(self.classes.values()):
+            for name, node in info.methods.items():
+                self._walk_function(self._func_key(info, name), info, node)
+        for mod in self.mods:
+            for stmt in mod.tree.body:
+                if isinstance(stmt, _FUNC_NODES):
+                    key = f"{mod.name}:{stmt.name}"
+                    self.funcs[key] = FuncNode(key, mod, stmt, None, stmt.name)
+        for key, fn in list(self.funcs.items()):
+            if fn.cls is None:
+                self._walk_function(key, None, fn.node, register=False)
+
+    def _func_key(self, info: ClassInfo, name: str) -> str:
+        return f"{info.key}.{name}"
+
+    def _index_class(self, mod: Module, node: ast.ClassDef) -> None:
+        key = f"{mod.name}:{node.name}"
+        frozen = is_dc = False
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            dname = target.attr if isinstance(target, ast.Attribute) else (
+                target.id if isinstance(target, ast.Name) else "")
+            if dname == "dataclass":
+                is_dc = True
+                if isinstance(deco, ast.Call):
+                    for kw in deco.keywords:
+                        if (kw.arg == "frozen"
+                                and isinstance(kw.value, ast.Constant)
+                                and kw.value.value):
+                            frozen = True
+        info = ClassInfo(key=key, module=mod, node=node,
+                         frozen=frozen, is_dataclass=is_dc)
+        for stmt in node.body:
+            if isinstance(stmt, _FUNC_NODES):
+                info.methods[stmt.name] = stmt
+        self.classes[key] = info
+        for name, mnode in info.methods.items():
+            fkey = self._func_key(info, name)
+            self.funcs[fkey] = FuncNode(fkey, mod, mnode, info, name)
+
+    def _index_module_scope(self, mod: Module) -> None:
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                kind = self._threading_ctor(mod, stmt.value)
+                if kind in _LOCK_KINDS:
+                    ident = ("global", mod.name, stmt.targets[0].id)
+                    decl = LockDecl(ident, _LOCK_KINDS[kind], ident,
+                                    borrowed=False, line=stmt.lineno)
+                    self.module_locks[(mod.name, stmt.targets[0].id)] = decl
+                    self._decls[ident] = decl
+
+    def _threading_ctor(self, mod: Module, node: ast.AST) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition'/'Thread'/... when `node` is a call to
+        (or a reference of) that threading member; else None."""
+        if isinstance(node, ast.Call):
+            node = node.func
+        aliases = self._threading.get(mod.name, {})
+        if isinstance(node, ast.Name):
+            member = aliases.get(node.id)
+            return member if member not in (None, "*") else None
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if aliases.get(node.value.id) == "*":
+                return node.attr
+        return None
+
+    # -- phase 2: types and locks ---------------------------------------------
+
+    def _chase_class(self, modname: str, name: str, depth: int = 0
+                     ) -> Optional[str]:
+        """Class key for `name` as seen from `modname`, following re-export
+        chains (`from .scheduler import Scheduler` in sched/__init__.py)."""
+        key = f"{modname}:{name}"
+        if key in self.classes:
+            return key
+        if depth >= 5:
+            return None
+        binding = self.graph.imports.get(modname, {}).get(name)
+        if binding is not None and binding[0] == "func":
+            return self._chase_class(binding[1], binding[2], depth + 1)
+        return None
+
+    def _resolve_class_name(self, mod: Module, node: ast.AST) -> Optional[str]:
+        """Class key for a Name / dotted reference, through imports."""
+        if isinstance(node, ast.Name):
+            return self._class_by_local.get((mod.name, node.id))
+        if isinstance(node, ast.Attribute):
+            parts = []
+            cur: ast.AST = node
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if not isinstance(cur, ast.Name):
+                return None
+            binding = self.graph.imports.get(mod.name, {}).get(cur.id)
+            if binding and binding[0] == "mod" and len(parts) == 1:
+                return self._chase_class(binding[1], parts[0])
+        return None
+
+    def _resolve_annotation_t(self, mod: Module, node) -> Optional[tuple]:
+        """("inst"|"coll", class_key) for an annotation, or None.
+        `dict[str, Counter]` / `list[T]` style containers resolve to
+        ("coll", element-class) — the DICT VALUE is the element."""
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            try:
+                node = ast.parse(node.value, mode="eval").body
+            except SyntaxError:
+                return None
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            return (self._resolve_annotation_t(mod, node.left)
+                    or self._resolve_annotation_t(mod, node.right))
+        if isinstance(node, ast.Subscript):
+            head = node.value
+            hname = head.attr if isinstance(head, ast.Attribute) else (
+                head.id if isinstance(head, ast.Name) else "")
+            if hname == "Optional":
+                return self._resolve_annotation_t(mod, node.slice)
+            if hname == "Union":
+                sl = node.slice
+                for e in (sl.elts if isinstance(sl, ast.Tuple) else [sl]):
+                    r = self._resolve_annotation_t(mod, e)
+                    if r is not None:
+                        return r
+                return None
+            if hname in _CONTAINER_NAMES:
+                elt = node.slice
+                if isinstance(elt, ast.Tuple) and elt.elts:
+                    elt = elt.elts[-1]
+                inner = self._resolve_annotation_t(mod, elt)
+                if inner is not None and inner[0] == "inst":
+                    return ("coll", inner[1])
+                return None
+            return None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            key = self._resolve_class_name(mod, node)
+            return ("inst", key) if key is not None else None
+        return None
+
+    def _resolve_annotation(self, mod: Module, ann) -> Optional[str]:
+        t = self._resolve_annotation_t(mod, ann)
+        return t[1] if t is not None and t[0] == "inst" else None
+
+    def _param_annotations(self, mod: Module, fnode) -> dict:
+        out: dict = {}
+        args = fnode.args
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.annotation is not None:
+                key = self._resolve_annotation(mod, a.annotation)
+                if key is not None:
+                    out[a.arg] = key
+        return out
+
+    def _infer_class_attrs(self, info: ClassInfo) -> None:
+        mod = info.module
+        for mname, mnode in info.methods.items():
+            params = self._param_annotations(mod, mnode)
+            for node in ast.walk(mnode):
+                if isinstance(node, ast.AnnAssign):
+                    attr = _is_self_attr(node.target)
+                    if attr is not None:
+                        t = self._resolve_annotation_t(mod, node.annotation)
+                        if t is not None:
+                            info.attr_types.setdefault(attr, t)
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    attr = _is_self_attr(tgt)
+                    if attr is None:
+                        continue
+                    self._record_attr_value(info, attr, node.value,
+                                            params, node.lineno)
+        # dataclass field annotations double as attribute types
+        if info.is_dataclass:
+            for stmt in info.node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    t = self._resolve_annotation_t(mod, stmt.annotation)
+                    if t is not None:
+                        info.attr_types.setdefault(stmt.target.id, t)
+
+    def _record_attr_value(self, info: ClassInfo, attr: str, value: ast.AST,
+                           params: dict, line: int) -> None:
+        mod = info.module
+        # lock declarations --------------------------------------------------
+        kind = self._threading_ctor(mod, value)
+        if kind in _LOCK_KINDS:
+            ident = ("attr", info.key, attr)
+            underlying = ident
+            if kind == "Condition" and isinstance(value, ast.Call) \
+                    and value.args:
+                shared = _is_self_attr(value.args[0])
+                if shared is not None:
+                    underlying = ("attr", info.key, shared)
+            decl = LockDecl(ident, _LOCK_KINDS[kind], underlying,
+                            borrowed=False, line=line)
+            info.locks[attr] = decl
+            self._decls[ident] = decl
+            return
+        if kind in _SYNC_TYPES:
+            info.attr_types[attr] = ("sync", kind)
+            return
+        # borrowed lock: self._lock = lock (param annotated or named lock-ish)
+        if isinstance(value, ast.Name) and value.id in (
+                "lock", "rlock", "_lock"):
+            ident = ("attr", info.key, attr)
+            decl = LockDecl(ident, "lock", ident, borrowed=True, line=line)
+            info.locks[attr] = decl
+            self._decls[ident] = decl
+            return
+        # plain types ---------------------------------------------------------
+        t = self._value_type(mod, value, params, info)
+        if t is not None:
+            prev = info.attr_types.get(attr)
+            if prev is None or prev == t:
+                info.attr_types[attr] = t
+            elif prev[0] != "sync":
+                info.attr_types[attr] = prev  # first inference wins
+
+    def _value_type(self, mod: Module, value: ast.AST, params: dict,
+                    info: Optional[ClassInfo]) -> Optional[tuple]:
+        if isinstance(value, ast.IfExp):
+            return (self._value_type(mod, value.body, params, info)
+                    or self._value_type(mod, value.orelse, params, info))
+        if isinstance(value, ast.Name):
+            if value.id in params:
+                return ("inst", params[value.id])
+            g = self.module_globals.get((mod.name, value.id))
+            return g
+        if isinstance(value, ast.Attribute):
+            # module-alias attribute: obs_metrics.REGISTRY
+            if isinstance(value.value, ast.Name):
+                binding = self.graph.imports.get(mod.name, {}).get(
+                    value.value.id)
+                if binding and binding[0] == "mod":
+                    return self.module_globals.get((binding[1], value.attr))
+            return None
+        if isinstance(value, ast.Call):
+            key = self._resolve_class_name(mod, value.func)
+            if key is not None:
+                return ("inst", key)
+            return None
+        # containers of constructed instances: {k: T(...) ...}, [T(...)]
+        elts: list = []
+        if isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+            elts = value.elts
+        elif isinstance(value, ast.Dict):
+            elts = [v for v in value.values if v is not None]
+        elif isinstance(value, (ast.ListComp, ast.SetComp)):
+            elts = [value.elt]
+        elif isinstance(value, ast.DictComp):
+            elts = [value.value]
+        keys = {self._resolve_class_name(mod, e.func)
+                for e in elts if isinstance(e, ast.Call)}
+        keys.discard(None)
+        if len(keys) == 1 and len(elts) >= 1:
+            return ("coll", keys.pop())
+        return None
+
+    def _infer_module_globals(self, mod: Module) -> None:
+        for stmt in mod.tree.body:
+            tgt = None
+            value = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                tgt, value = stmt.targets[0].id, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name):
+                tgt = stmt.target.id
+                t = self._resolve_annotation_t(mod, stmt.annotation)
+                if t is not None:
+                    self.module_globals[(mod.name, tgt)] = t
+                continue
+            if tgt is None or value is None:
+                continue
+            if isinstance(value, ast.Call):
+                key = self._resolve_class_name(mod, value.func)
+                if key is not None:
+                    self.module_globals[(mod.name, tgt)] = ("inst", key)
+
+    # -- phase 3: function-body walk -------------------------------------------
+
+    def _lock_ref(self, mod: Module, info: Optional[ClassInfo],
+                  node: ast.AST) -> Optional[LockDecl]:
+        attr = _is_self_attr(node)
+        if attr is not None and info is not None:
+            return info.locks.get(attr)
+        if isinstance(node, ast.Name):
+            return self.module_locks.get((mod.name, node.id))
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name):
+            binding = self.graph.imports.get(mod.name, {}).get(node.value.id)
+            if binding and binding[0] == "mod":
+                return self.module_locks.get((binding[1], node.attr))
+        return None
+
+    def _walk_function(self, key: str, info: Optional[ClassInfo],
+                       fnode: ast.AST, register: bool = True) -> None:
+        mod = self.funcs[key].module
+        env: dict = dict(self._param_annotations(mod, fnode).items())
+        env = {k: ("inst", v) for k, v in env.items()}
+        in_init = self.funcs[key].is_init
+
+        def expr_type(e: ast.AST) -> Optional[tuple]:
+            if isinstance(e, ast.Name):
+                if e.id in env:
+                    return env[e.id]
+                return self.module_globals.get((mod.name, e.id))
+            if isinstance(e, ast.Attribute):
+                attr = _is_self_attr(e)
+                if attr is not None and info is not None:
+                    return info.attr_types.get(attr)
+                if isinstance(e.value, ast.Name):
+                    binding = self.graph.imports.get(mod.name, {}).get(
+                        e.value.id)
+                    if binding and binding[0] == "mod":
+                        return self.module_globals.get((binding[1], e.attr))
+                return None
+            if isinstance(e, ast.Subscript):
+                base = expr_type(e.value)
+                if base is not None and base[0] == "coll":
+                    return ("inst", base[1])
+                return None
+            if isinstance(e, ast.Call):
+                return self._call_type(mod, info, e, expr_type)
+            return None
+
+        def resolve_funcref(e: ast.AST) -> Optional[str]:
+            """FuncNode key for a function/bound-method REFERENCE."""
+            attr = _is_self_attr(e)
+            if attr is not None and info is not None \
+                    and attr in info.methods:
+                return self._func_key(info, attr)
+            if isinstance(e, ast.Attribute):
+                base = expr_type(e.value)
+                if base is not None and base[0] == "inst":
+                    target = self.classes.get(base[1])
+                    if target is not None and e.attr in target.methods:
+                        return f"{base[1]}.{e.attr}"
+                return None
+            if isinstance(e, ast.Name):
+                cand = f"{mod.name}:{e.id}"
+                if cand in self.funcs:
+                    return cand
+                binding = self.graph.imports.get(mod.name, {}).get(e.id)
+                if binding and binding[0] == "func":
+                    cand = f"{binding[1]}:{binding[2]}"
+                    if cand in self.funcs:
+                        return cand
+            return None
+
+        def receiver_class(e: ast.AST) -> Optional[str]:
+            attr = _is_self_attr(e)
+            if attr is not None and info is not None \
+                    and attr in info.methods:
+                return info.key
+            if isinstance(e, ast.Attribute):
+                base = expr_type(e.value)
+                if base is not None and base[0] == "inst":
+                    return base[1]
+            return None
+
+        def resolve_call(call: ast.Call) -> Optional[str]:
+            func = call.func
+            ref = resolve_funcref(func)
+            if ref is not None:
+                return ref
+            # constructor: T(...) -> T.__init__
+            cls_key = self._resolve_class_name(mod, func)
+            if cls_key is not None:
+                target = self.classes.get(cls_key)
+                if target is not None and "__init__" in target.methods:
+                    return f"{cls_key}.__init__"
+                return None
+            q = self.graph.resolved.get(id(call))
+            if q is not None and q in self.funcs \
+                    and self.funcs[q].cls is None:
+                return q
+            return None
+
+        def record_access(attr: str, op: str, kind: str, line: int,
+                          held: frozenset) -> None:
+            if info is None or attr in info.locks or attr in info.methods:
+                return
+            t = info.attr_types.get(attr)
+            if t is not None and t[0] == "sync":
+                return
+            self.accesses.append(FieldAccess(
+                cls=info, attr=attr, func=key, module=mod, line=line,
+                kind=kind, op=op, held=held, in_init=in_init))
+
+        def handle_thread_call(call: ast.Call, held: frozenset) -> None:
+            """threading.Thread(...) / subscribe-style registrations."""
+            ctor = self._threading_ctor(mod, call)
+            if ctor == "Thread":
+                target = next((kw.value for kw in call.keywords
+                               if kw.arg == "target"), None)
+                if target is not None:
+                    ref = resolve_funcref(target)
+                    if ref is not None:
+                        self.roots.append(ThreadRoot(
+                            ref, "thread", mod, call.lineno))
+                    recv = receiver_class(target)
+                    if recv is not None:
+                        self.escapes.append(EscapeSite(
+                            mod, call.lineno, recv, "thread-target",
+                            detail="Thread target receiver"))
+                for kw in call.keywords:
+                    if kw.arg != "args":
+                        continue
+                    elts = (kw.value.elts
+                            if isinstance(kw.value, (ast.Tuple, ast.List))
+                            else [])
+                    for elt in elts:
+                        t = expr_type(elt)
+                        if t is not None and t[0] == "inst":
+                            self.escapes.append(EscapeSite(
+                                mod, call.lineno, t[1], "thread-arg",
+                                detail="passed to thread args"))
+                return
+            func = call.func
+            fname = (func.attr if isinstance(func, ast.Attribute)
+                     else func.id if isinstance(func, ast.Name) else "")
+            if fname.startswith("subscribe") or fname.startswith("register"):
+                for arg in call.args:
+                    ref = resolve_funcref(arg)
+                    if ref is not None:
+                        self.roots.append(ThreadRoot(
+                            ref, "callback", mod, call.lineno))
+
+        def visit(node: ast.AST, held: frozenset) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = set()
+                for item in node.items:
+                    decl = self._lock_ref(mod, info, item.context_expr)
+                    if decl is not None:
+                        self.acquires.append(Acquire(
+                            key, decl, held, mod, item.context_expr.lineno))
+                        acquired.add(decl.underlying)
+                    else:
+                        visit(item.context_expr, held)
+                inner = held | frozenset(acquired)
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, _FUNC_NODES) and node is not fnode:
+                # nested def: its own FuncNode, synthetically "called" here
+                # (closures are invoked by their enclosing stage in practice)
+                nested = f"{key}.<{node.name}>"
+                if nested not in self.funcs:
+                    self.funcs[nested] = FuncNode(
+                        nested, mod, node, info, node.name)
+                    self.edges.append(CallEdge(key, nested, held, mod,
+                                               node.lineno))
+                    self._walk_function(nested, info, node, register=False)
+                return
+            if isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, ast.Call):
+                handle_thread_call(node, held)
+                callee = resolve_call(node)
+                if callee is not None:
+                    self.edges.append(CallEdge(
+                        key, callee, held, mod, node.lineno))
+                # mutating/reading method call on a self attribute
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    attr = _is_self_attr(func.value)
+                    if attr is not None:
+                        op = "mutcall" if func.attr in MUTATORS else "load"
+                        record_access(
+                            attr, op,
+                            "write" if func.attr in MUTATORS else "read",
+                            node.lineno, held)
+                        for sub in (*node.args,
+                                    *(kw.value for kw in node.keywords)):
+                            visit(sub, held)
+                        return
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+                return
+            if isinstance(node, ast.Assign):
+                # local type tracking: x = <typed expr>
+                if len(node.targets) == 1 and isinstance(
+                        node.targets[0], ast.Name):
+                    t = expr_type(node.value)
+                    name = node.targets[0].id
+                    if t is not None and env.get(name, t) == t:
+                        env[name] = t
+                    elif name in env:
+                        del env[name]
+            if isinstance(node, ast.For):
+                # loop-target typing: `for c in <coll>:` / `.values()` /
+                # `for k, v in <coll>.items():` bind the element type
+                t = expr_type(node.iter)
+                tgt = node.target
+                if t is not None and t[0] == "coll" \
+                        and isinstance(tgt, ast.Name):
+                    env[tgt.id] = ("inst", t[1])
+                elif (isinstance(node.iter, ast.Call)
+                      and isinstance(node.iter.func, ast.Attribute)
+                      and node.iter.func.attr == "items"
+                      and isinstance(tgt, ast.Tuple)
+                      and len(tgt.elts) == 2
+                      and isinstance(tgt.elts[1], ast.Name)):
+                    base = expr_type(node.iter.func.value)
+                    if base is not None and base[0] == "coll":
+                        env[tgt.elts[1].id] = ("inst", base[1])
+            if isinstance(node, ast.AugAssign):
+                attr = _is_self_attr(node.target)
+                if attr is not None:
+                    op = "aug-add" if isinstance(node.op, ast.Add) else "aug"
+                    record_access(attr, op, "write", node.lineno, held)
+                    visit(node.value, held)
+                    return
+                if isinstance(node.target, ast.Subscript):
+                    attr = _is_self_attr(node.target.value)
+                    if attr is not None:
+                        record_access(attr, "subscript", "write",
+                                      node.lineno, held)
+                        visit(node.value, held)
+                        visit(node.target.slice, held)
+                        return
+            if isinstance(node, ast.Attribute):
+                attr = _is_self_attr(node)
+                if attr is not None:
+                    if isinstance(node.ctx, ast.Store):
+                        record_access(attr, "store", "write",
+                                      node.lineno, held)
+                    elif isinstance(node.ctx, ast.Del):
+                        record_access(attr, "del", "write", node.lineno, held)
+                    else:
+                        record_access(attr, "load", "read", node.lineno, held)
+                    return
+            if isinstance(node, ast.Subscript):
+                attr = _is_self_attr(node.value)
+                if attr is not None:
+                    if isinstance(node.ctx, (ast.Store, ast.Del)):
+                        record_access(attr, "subscript", "write",
+                                      node.lineno, held)
+                    else:
+                        record_access(attr, "load", "read", node.lineno, held)
+                    visit(node.slice, held)
+                    return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in fnode.body:
+            visit(stmt, frozenset())
+
+    def _call_type(self, mod, info, call: ast.Call, expr_type):
+        """Type of a call's result: constructor, or return annotation."""
+        key = self._resolve_class_name(mod, call.func)
+        if key is not None:
+            return ("inst", key)
+        # method with a return annotation, on a typed receiver
+        func = call.func
+        target = None
+        if isinstance(func, ast.Attribute):
+            base = expr_type(func.value)
+            if base is not None and base[0] == "coll":
+                # dict/list protocol on a typed container
+                if func.attr in ("get", "pop", "setdefault", "popleft"):
+                    return ("inst", base[1])
+                if func.attr in ("values", "copy"):
+                    return ("coll", base[1])
+                return None
+            if base is not None and base[0] == "inst":
+                cls = self.classes.get(base[1])
+                if cls is not None:
+                    target = cls.methods.get(func.attr)
+                    tmod = cls.module
+        elif isinstance(func, ast.Name):
+            q = self.graph.resolved.get(id(call))
+            if q is not None and q in self.funcs and self.funcs[q].cls is None:
+                target = self.funcs[q].node
+                tmod = self.funcs[q].module
+        if target is not None and getattr(target, "returns", None) is not None:
+            ret = self._resolve_annotation(tmod, target.returns)
+            if ret is not None:
+                return ("inst", ret)
+        return None
+
+    # -- phase 4: fixpoints ----------------------------------------------------
+
+    def _solve(self) -> None:
+        for e in self.edges:
+            self._in_edges.setdefault(e.callee, []).append(e)
+            self._out_edges.setdefault(e.caller, []).append(e)
+        for a in self.acquires:
+            self._acq_by_func.setdefault(a.func, []).append(a)
+
+        # entry locks: ⋂ over in-scan call sites of (held ∪ entry(caller));
+        # no in-scan callers -> ∅ (callable bare from anywhere).
+        TOP = None
+        entry: dict = {k: (frozenset() if k not in self._in_edges else TOP)
+                       for k in self.funcs}
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for k, edges in self._in_edges.items():
+                acc = TOP
+                for e in edges:
+                    ce = entry.get(e.caller, frozenset())
+                    if ce is TOP:
+                        continue  # unreached caller: contributes ⊤
+                    site = e.held | ce
+                    acc = site if acc is TOP else (acc & site)
+                if acc is not TOP and entry[k] != acc:
+                    if entry[k] is TOP or acc < entry[k]:
+                        entry[k] = acc
+                        changed = True
+            if not changed:
+                break
+        self.entry_locks = {k: (v if v is not TOP else frozenset())
+                            for k, v in entry.items()}
+
+        # root labels: thread targets/callbacks seed thread:<key>; public
+        # callables seed "main"; labels flow along call edges.
+        labels: dict = {k: set() for k in self.funcs}
+        for r in self.roots:
+            if r.func in labels:
+                labels[r.func].add(r.label)
+        for k, fn in self.funcs.items():
+            if fn.is_public and not fn.is_init:
+                labels[k].add("main")
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for e in self.edges:
+                src = labels.get(e.caller)
+                if not src:
+                    continue
+                dst = labels.setdefault(e.callee, set())
+                before = len(dst)
+                dst |= src
+                changed = changed or len(dst) != before
+            if not changed:
+                break
+        self.labels = labels
+
+        # transitive acquisitions (for the lock-order rule)
+        acq: dict = {k: {a.decl.underlying for a in
+                         self._acq_by_func.get(k, [])} for k in self.funcs}
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for e in self.edges:
+                src = acq.get(e.callee, set())
+                if not src:
+                    continue
+                dst = acq[e.caller]
+                before = len(dst)
+                dst |= src
+                changed = changed or len(dst) != before
+            if not changed:
+                break
+        self.transitive_acquires = acq
+
+    # -- queries ----------------------------------------------------------------
+
+    def effective_held(self, access: FieldAccess) -> frozenset:
+        return access.held | self.entry_locks.get(access.func, frozenset())
+
+    def func_labels(self, key: str) -> set:
+        return self.labels.get(key, set())
+
+    def decl_for(self, ident: tuple) -> Optional[LockDecl]:
+        return self._decls.get(ident)
+
+    def thread_rooted_classes(self) -> set:
+        """Class keys that OWN a thread root (a Thread target or registered
+        callback method) — the shared services whose attrs thread-escape
+        audits."""
+        out = set()
+        for r in self.roots:
+            fn = self.funcs.get(r.func)
+            if fn is not None and fn.cls is not None:
+                out.add(fn.cls.key)
+        return out
+
+    def unguarded_mutators(self, cls_key: str) -> dict:
+        """method name -> example line, for methods of `cls_key` containing
+        a non-init field write with an EMPTY effective lock set (ignoring
+        GIL-atomic whole-attr publish stores)."""
+        info = self.classes.get(cls_key)
+        if info is None:
+            return {}
+        out: dict = {}
+        for a in self.accesses:
+            if a.cls is not info or a.kind != "write" or a.in_init:
+                continue
+            if a.op == "store":
+                continue  # single whole-value publish: atomic under the GIL
+            if not self.effective_held(a):
+                fn = self.funcs.get(a.func)
+                name = fn.name if fn is not None else a.func
+                out.setdefault(name, a.line)
+        return out

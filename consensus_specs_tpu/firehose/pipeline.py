@@ -387,8 +387,10 @@ class AttestationFirehose:
     def _flush_once(self, trigger: str) -> None:
         reg = self.registry
         entries, members = self.scheduler.queue_load("bls")
+        with self._lock:
+            pending = self._pending
         _flight.record("queue", trigger=trigger, committees=entries,
-                       attestations=members, pending=self._pending)
+                       attestations=members, pending=pending)
         with _obs_trace.span("firehose.flush", trigger=trigger,
                              committees=entries, attestations=members):
             if entries:
@@ -416,6 +418,7 @@ class AttestationFirehose:
         now = time.monotonic()
         verified = rejected = 0
         first_error = None
+        batch: list = []
         with self._lock:
             still: list = []
             done: list = []
@@ -430,16 +433,18 @@ class AttestationFirehose:
                     still.append(rec)
             self._awaiting = still
             self._pending -= len(done)
-            for msg_id, _key, handle, t_ingest in done:
+            for msg_id, key, handle, t_ingest in done:
                 ok = bool(handle.result())
                 self._results[msg_id] = ok
                 tr = handle.request.trace
                 lat.observe(max(0.0, now - t_ingest),
                             exemplar=(tr.trace_id if tr is not None
                                       else None))
+                batch.append((msg_id, key, ok, now))
                 verified += ok
                 rejected += not ok
             reg.gauge("firehose_queue_depth").set(self._pending)
+            subs = list(self._verified_subs)
             self._room.notify_all()
         if done and _obs_trace.current_tracer() is not None:
             # resolve marker: links every request whose verdict landed in
@@ -455,15 +460,15 @@ class AttestationFirehose:
             reg.counter("firehose_verified_total").inc(verified)
         if rejected:
             reg.counter("firehose_rejected_total").inc(rejected)
-        if done and self._verified_subs:
+        if batch and subs:
             # consumer seam (the ProofService dirty-column precedent):
             # one batch record per resolved verdict, delivered OUTSIDE the
-            # lock so a consumer may call back into the pipeline. A
+            # lock so a consumer may call back into the pipeline — but the
+            # batch and the subscriber list were both captured UNDER it,
+            # so a concurrent subscribe/result mutation can't tear them. A
             # subscriber fault is the subscriber's incident, not the
             # stream's — counted, flight-recorded, never re-raised.
-            batch = [(msg_id, key, self._results[msg_id], now)
-                     for msg_id, key, _handle, _t in done]
-            for callback in list(self._verified_subs):
+            for callback in subs:
                 try:
                     callback(batch)
                 except Exception as exc:

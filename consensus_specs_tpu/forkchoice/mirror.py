@@ -23,6 +23,7 @@ layer and the degraded host-oracle path.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 import numpy as np
@@ -69,6 +70,12 @@ class StoreMirror:
     """Incrementally mirror a Store (or a hand-built tree) in gather form."""
 
     def __init__(self):
+        # One reentrant lock over every public entry point: the mirror is
+        # mutated by whichever thread delivers the verified-batch callback
+        # (the firehose flush worker) and read by callers on the main
+        # thread (`head`, bench drivers). RLock, not Lock — `sync` re-enters
+        # `add_block` and `snapshot` is called under `head`'s sync.
+        self._lock = threading.RLock()
         self._block_index: dict = {}   # root bytes -> block index
         self._roots: list = []         # block index -> root bytes
         self._parent: list = []
@@ -89,17 +96,21 @@ class StoreMirror:
         self._genesis_epoch = 0
 
     def __len__(self) -> int:
-        return len(self._roots)
+        with self._lock:
+            return len(self._roots)
 
     @property
     def n_validators(self) -> int:
-        return int(self._votes.shape[0])
+        with self._lock:
+            return int(self._votes.shape[0])
 
     def root_at(self, index: int) -> bytes:
-        return self._roots[index]
+        with self._lock:
+            return self._roots[index]
 
     def index_of(self, root) -> int:
-        return self._block_index[bytes(root)]
+        with self._lock:
+            return self._block_index[bytes(root)]
 
     def _rid(self, root: bytes) -> int:
         rid = self._rids.get(root)
@@ -125,127 +136,134 @@ class StoreMirror:
         """Append one block; the parent must already be present (or equal
         the block's own root for the anchor). `justified`/`finalized` are
         the block state's (epoch, checkpoint-root) pairs."""
-        rb = bytes(root)
-        pb = bytes(parent_root)
-        if rb in self._block_index:
-            return self._block_index[rb]
-        index = len(self._roots)
-        self._block_index[rb] = index
-        self._roots.append(rb)
-        self._parent.append(self._block_index.get(pb, index))
-        self._slots.append(int(slot))
-        self._root_words.append(
-            np.frombuffer(rb, dtype=">u4").astype(np.uint32))
-        self._ck_epochs.append((int(justified[0]), int(finalized[0])))
-        self._ck_rids.append((self._rid(bytes(justified[1])),
-                              self._rid(bytes(finalized[1]))))
-        return index
+        with self._lock:
+            rb = bytes(root)
+            pb = bytes(parent_root)
+            if rb in self._block_index:
+                return self._block_index[rb]
+            index = len(self._roots)
+            self._block_index[rb] = index
+            self._roots.append(rb)
+            self._parent.append(self._block_index.get(pb, index))
+            self._slots.append(int(slot))
+            self._root_words.append(
+                np.frombuffer(rb, dtype=">u4").astype(np.uint32))
+            self._ck_epochs.append((int(justified[0]), int(finalized[0])))
+            self._ck_rids.append((self._rid(bytes(justified[1])),
+                                  self._rid(bytes(finalized[1]))))
+            return index
 
     def set_registry(self, balances) -> None:
         """Replace the effective-balance lane (grows the vote lane)."""
         balances = np.asarray(balances, dtype=np.int64)
-        self._grow_validators(balances.shape[0])
-        self._balances[:balances.shape[0]] = balances
-        self._balances[balances.shape[0]:] = 0
+        with self._lock:
+            self._grow_validators(balances.shape[0])
+            self._balances[:balances.shape[0]] = balances
+            self._balances[balances.shape[0]:] = 0
 
     def set_vote(self, index: int, root) -> None:
         """Record validator `index`'s latest message as a block root (or
         None to clear). Admission filtering is the caller's job — the
         service routes through testlib's `latest_message_updates`."""
-        self._grow_validators(int(index) + 1)
-        self._votes[int(index)] = (
-            -1 if root is None else self._block_index[bytes(root)])
+        with self._lock:
+            self._grow_validators(int(index) + 1)
+            self._votes[int(index)] = (
+                -1 if root is None else self._block_index[bytes(root)])
 
     def set_checkpoints(self, justified, finalized, *,
                         genesis_epoch: int = 0) -> None:
         """Set the store-level (epoch, root) checkpoint pair; the
         justified root must be a known block."""
-        self._justified_idx = self._block_index[bytes(justified[1])]
-        self._store_justified = (int(justified[0]),
-                                 self._rid(bytes(justified[1])))
-        self._store_finalized = (int(finalized[0]),
-                                 self._rid(bytes(finalized[1])))
-        self._genesis_epoch = int(genesis_epoch)
+        with self._lock:
+            self._justified_idx = self._block_index[bytes(justified[1])]
+            self._store_justified = (int(justified[0]),
+                                     self._rid(bytes(justified[1])))
+            self._store_finalized = (int(finalized[0]),
+                                     self._rid(bytes(finalized[1])))
+            self._genesis_epoch = int(genesis_epoch)
 
     def set_boost(self, root, weight: int = 0) -> None:
-        self._boost_idx = (-1 if root is None
-                           else self._block_index.get(bytes(root), -1))
-        self._boost_weight = int(weight)
+        with self._lock:
+            self._boost_idx = (-1 if root is None
+                               else self._block_index.get(bytes(root), -1))
+            self._boost_weight = int(weight)
 
     # --- incremental Store sync -------------------------------------------
 
     def sync(self, spec, store) -> None:
         """Fold the Store's growth since the last sync into the mirror."""
-        blocks = store.blocks
-        if len(blocks) > len(self._roots):
-            for root, block in list(blocks.items())[len(self._roots):]:
-                state = store.block_states[root]
-                cj = state.current_justified_checkpoint
-                cf = state.finalized_checkpoint
-                self.add_block(
-                    root, block.parent_root, block.slot,
-                    justified=(int(cj.epoch), bytes(cj.root)),
-                    finalized=(int(cf.epoch), bytes(cf.root)))
+        with self._lock:
+            blocks = store.blocks
+            if len(blocks) > len(self._roots):
+                for root, block in list(blocks.items())[len(self._roots):]:
+                    state = store.block_states[root]
+                    cj = state.current_justified_checkpoint
+                    cf = state.finalized_checkpoint
+                    self.add_block(
+                        root, block.parent_root, block.slot,
+                        justified=(int(cj.epoch), bytes(cj.root)),
+                        finalized=(int(cf.epoch), bytes(cf.root)))
 
-        jc = store.justified_checkpoint
-        jkey = (int(jc.epoch), bytes(jc.root))
-        if jkey != self._justified_key:
-            state = store.checkpoint_states[jc]
-            active = spec.get_active_validator_indices(
-                state, spec.get_current_epoch(state))
-            self._grow_validators(len(state.validators))
-            self._balances[:] = 0
-            validators = state.validators
-            for i in active:
-                self._balances[int(i)] = int(
-                    validators[int(i)].effective_balance)
-            num = len(active)
-            if num:
-                # spec get_latest_attesting_balance proposer_score:
-                # (num_active/SLOTS_PER_EPOCH) * avg_balance * BOOST // 100
-                avg = int(spec.get_total_active_balance(state)) // num
-                committee_size = num // int(spec.SLOTS_PER_EPOCH)
-                self._boost_weight = (
-                    committee_size * avg
-                    * int(spec.config.PROPOSER_SCORE_BOOST)) // 100
-            else:
-                self._boost_weight = 0
-            self._justified_key = jkey
+            jc = store.justified_checkpoint
+            jkey = (int(jc.epoch), bytes(jc.root))
+            if jkey != self._justified_key:
+                state = store.checkpoint_states[jc]
+                active = spec.get_active_validator_indices(
+                    state, spec.get_current_epoch(state))
+                self._grow_validators(len(state.validators))
+                self._balances[:] = 0
+                validators = state.validators
+                for i in active:
+                    self._balances[int(i)] = int(
+                        validators[int(i)].effective_balance)
+                num = len(active)
+                if num:
+                    # spec get_latest_attesting_balance proposer_score:
+                    # (num_active/SLOTS_PER_EPOCH) * avg_balance * BOOST // 100
+                    avg = int(spec.get_total_active_balance(state)) // num
+                    committee_size = num // int(spec.SLOTS_PER_EPOCH)
+                    self._boost_weight = (
+                        committee_size * avg
+                        * int(spec.config.PROPOSER_SCORE_BOOST)) // 100
+                else:
+                    self._boost_weight = 0
+                self._justified_key = jkey
 
-        for i, lm in store.latest_messages.items():
-            index = int(i)
-            entry = (int(lm.epoch), bytes(lm.root))
-            if self._lm_cache.get(index) != entry:
-                self._lm_cache[index] = entry
-                self._grow_validators(index + 1)
-                self._votes[index] = self._block_index.get(entry[1], -1)
+            for i, lm in store.latest_messages.items():
+                index = int(i)
+                entry = (int(lm.epoch), bytes(lm.root))
+                if self._lm_cache.get(index) != entry:
+                    self._lm_cache[index] = entry
+                    self._grow_validators(index + 1)
+                    self._votes[index] = self._block_index.get(entry[1], -1)
 
-        fc = store.finalized_checkpoint
-        self._justified_idx = self._block_index[bytes(jc.root)]
-        self._store_justified = (int(jc.epoch), self._rid(bytes(jc.root)))
-        self._store_finalized = (int(fc.epoch), self._rid(bytes(fc.root)))
-        self._genesis_epoch = int(spec.GENESIS_EPOCH)
-        pb = bytes(store.proposer_boost_root)
-        self._boost_idx = (self._block_index.get(pb, -1)
-                           if pb != ZERO_ROOT else -1)
+            fc = store.finalized_checkpoint
+            self._justified_idx = self._block_index[bytes(jc.root)]
+            self._store_justified = (int(jc.epoch), self._rid(bytes(jc.root)))
+            self._store_finalized = (int(fc.epoch), self._rid(bytes(fc.root)))
+            self._genesis_epoch = int(spec.GENESIS_EPOCH)
+            pb = bytes(store.proposer_boost_root)
+            self._boost_idx = (self._block_index.get(pb, -1)
+                               if pb != ZERO_ROOT else -1)
 
     def snapshot(self) -> StoreSnapshot:
         """Freeze the current mirror state (arrays copied: snapshots cross
         the scheduler's thread boundary and must not alias live lanes)."""
-        b = len(self._roots)
-        if b == 0:
-            raise ValueError("empty mirror: no anchor block synced")
-        return StoreSnapshot(
-            parent=np.asarray(self._parent, dtype=np.int32),
-            slots=np.asarray(self._slots, dtype=np.int64),
-            root_words=np.vstack(self._root_words).astype(np.uint32),
-            ck_epochs=np.asarray(self._ck_epochs, dtype=np.int64),
-            ck_rids=np.asarray(self._ck_rids, dtype=np.int32),
-            votes=self._votes.copy(),
-            balances=self._balances.copy(),
-            justified_idx=int(self._justified_idx),
-            boost_idx=int(self._boost_idx),
-            boost_weight=int(self._boost_weight),
-            store_justified=self._store_justified,
-            store_finalized=self._store_finalized,
-            genesis_epoch=int(self._genesis_epoch))
+        with self._lock:
+            b = len(self._roots)
+            if b == 0:
+                raise ValueError("empty mirror: no anchor block synced")
+            return StoreSnapshot(
+                parent=np.asarray(self._parent, dtype=np.int32),
+                slots=np.asarray(self._slots, dtype=np.int64),
+                root_words=np.vstack(self._root_words).astype(np.uint32),
+                ck_epochs=np.asarray(self._ck_epochs, dtype=np.int64),
+                ck_rids=np.asarray(self._ck_rids, dtype=np.int32),
+                votes=self._votes.copy(),
+                balances=self._balances.copy(),
+                justified_idx=int(self._justified_idx),
+                boost_idx=int(self._boost_idx),
+                boost_weight=int(self._boost_weight),
+                store_justified=self._store_justified,
+                store_finalized=self._store_finalized,
+                genesis_epoch=int(self._genesis_epoch))

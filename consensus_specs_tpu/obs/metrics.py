@@ -249,17 +249,25 @@ class MetricsRegistry:
     def counter_value(self, name: str, **labels) -> int:
         """Read-only: 0 when the series was never created (reads must not
         materialize series, or snapshots would differ run to run)."""
-        c = self._counters.get(series_key(name, labels))
+        with self._lock:
+            c = self._counters.get(series_key(name, labels))
         return c.value if c is not None else 0
 
     def gauge_value(self, name: str, **labels):
-        g = self._gauges.get(series_key(name, labels))
+        with self._lock:
+            g = self._gauges.get(series_key(name, labels))
         return g.value if g is not None else 0.0
 
     def counters_matching(self, name: str) -> dict[str, int]:
-        """{series key: value} for every series of `name` (any label set)."""
+        """{series key: value} for every series of `name` (any label set).
+
+        The lock is not optional here: iterating `_counters` while the
+        flush worker registers a new series raises `RuntimeError: dict
+        changed size during iteration`."""
         prefix = name + "{"
-        return {k: c.value for k, c in sorted(self._counters.items())
+        with self._lock:
+            items = sorted(self._counters.items())
+        return {k: c.value for k, c in items
                 if k == name or k.startswith(prefix)}
 
     def reset(self) -> None:

@@ -26,6 +26,8 @@ jax-free at module level (tpulint import-layering).
 """
 from __future__ import annotations
 
+import threading
+
 from ..obs import flight as _flight
 from ..obs import metrics as _obs_metrics
 
@@ -68,6 +70,10 @@ class CircuitBreaker:
                  event_ring_size: int = EVENT_RING_SIZE):
         self.failure_threshold = int(failure_threshold)
         self.name = name
+        # The breaker is driven from the sched flush path (the firehose's
+        # flusher thread) and inspected from the main thread; one lock over
+        # every transition keeps the counter/event/state triple coherent.
+        self._lock = threading.Lock()
         self.state = CLOSED
         self.consecutive_failures = 0
         self.degraded_epochs = 0
@@ -77,33 +83,37 @@ class CircuitBreaker:
         """Call once per epoch before trying the device path. Returns the
         attempt mode: "closed" (full retry budget) or "probe" (single
         attempt; the breaker is half-open)."""
-        if self.state == OPEN:
-            self.state = HALF_OPEN
-            self._log("half_open_probe")
-        return "probe" if self.state == HALF_OPEN else "closed"
+        with self._lock:
+            if self.state == OPEN:
+                self.state = HALF_OPEN
+                self._log("half_open_probe")
+            return "probe" if self.state == HALF_OPEN else "closed"
 
     def record_success(self) -> None:
-        if self.state != CLOSED:
-            self._log("rearmed")
-        self.state = CLOSED
-        self.consecutive_failures = 0
+        with self._lock:
+            if self.state != CLOSED:
+                self._log("rearmed")
+            self.state = CLOSED
+            self.consecutive_failures = 0
 
     def record_failure(self, degraded: bool = True) -> None:
-        self.consecutive_failures += 1
-        if degraded:
-            self.degraded_epochs += 1
-            self._log("degraded_to_python")
-        if self.state == HALF_OPEN or \
-                self.consecutive_failures >= self.failure_threshold:
-            if self.state != OPEN:
-                self._log("opened")
-            self.state = OPEN
+        with self._lock:
+            self.consecutive_failures += 1
+            if degraded:
+                self.degraded_epochs += 1
+                self._log("degraded_to_python")
+            if self.state == HALF_OPEN or \
+                    self.consecutive_failures >= self.failure_threshold:
+                if self.state != OPEN:
+                    self._log("opened")
+                self.state = OPEN
 
     def reset(self) -> None:
-        self.state = CLOSED
-        self.consecutive_failures = 0
-        self.degraded_epochs = 0
-        self.events.clear()
+        with self._lock:
+            self.state = CLOSED
+            self.consecutive_failures = 0
+            self.degraded_epochs = 0
+            self.events.clear()
 
     def _log(self, event: str) -> None:
         before = self.events.dropped

@@ -1,0 +1,9 @@
+"""Fixture mini-package for the tpulint v3 concurrency rules.
+
+Sub-packages reuse the production plane names (`firehose/`, `sched/`,
+`forkchoice/`) so the path-scoped rules apply exactly as they do to the
+shipped package. Positive cases carry inline expectation annotations;
+the `_ok` modules encode the two shipped thread shapes (double-buffered
+flusher hand-off, subscriber callbacks delivered post-lock) as negatives
+so the rules stay precise.
+"""

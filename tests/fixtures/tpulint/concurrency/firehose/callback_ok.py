@@ -1,0 +1,45 @@
+"""Negative: subscriber callbacks delivered post-lock, the
+`subscribe_verified` shape.
+
+The batch and the subscriber list are both captured UNDER the lock; the
+callbacks run OUTSIDE it on thread-local copies, so a subscriber may
+re-enter the publisher without deadlocking and no shared field is
+touched unguarded.
+"""
+import threading
+
+
+class PostLockBroadcast:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: list = []
+        self._pending: list = []
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._deliver_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+
+    def subscribe(self, callback):
+        with self._lock:
+            self._subs.append(callback)
+
+    def publish(self, item):
+        with self._lock:
+            self._pending.append(item)
+
+    def _deliver_loop(self):
+        while not self._stop:
+            with self._lock:
+                batch, self._pending = self._pending, []
+                subs = list(self._subs)
+            for callback in subs:
+                for item in batch:
+                    callback(item)

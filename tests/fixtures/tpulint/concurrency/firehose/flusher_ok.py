@@ -1,0 +1,48 @@
+"""Negative: the double-buffered flusher hand-off, the firehose's shape.
+
+The producer appends under the lock; the flusher swaps the whole buffer
+out under the lock and walks the DETACHED batch outside it. Every access
+to the shared list happens under `_lock`, so guarded-field inference
+finds a dominating lock and stays quiet — the post-swap walk touches a
+local the flusher exclusively owns.
+"""
+import threading
+
+
+def _consume(item):
+    return item
+
+
+class DoubleBufferedFlusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf: list = []
+        self._flushed = 0
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+
+    def put(self, item):
+        with self._lock:
+            self._buf.append(item)
+
+    def flushed(self) -> int:
+        with self._lock:
+            return self._flushed
+
+    def _flush_loop(self):
+        while not self._stop:
+            with self._lock:
+                batch, self._buf = self._buf, []
+                self._flushed += len(batch)
+            for item in batch:
+                _consume(item)

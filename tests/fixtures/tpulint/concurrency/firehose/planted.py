@@ -1,0 +1,95 @@
+"""The planted race: per-key hit counts mutated by `ingest` (caller
+threads) and `drain` (the flusher thread) with no lock anywhere.
+
+This is the cross-validation anchor for the guarded-field rule: the
+static analysis must flag every unguarded access below, and the dynamic
+stress harness (tests/test_tpulint_concurrency.py) must make the SAME
+race lose real updates through the `gate` interleaving seam. The
+`LockedStatsPlane` control is byte-for-byte the same shape plus one
+lock — statically clean, dynamically loss-free — pinning both the rule
+and the harness as race-sensitive rather than shape-sensitive.
+"""
+import threading
+
+
+def _noop():
+    return None
+
+
+class RacyStatsPlane:
+    """`gate` is an interleaving seam: the stress harness parks ingest
+    threads between the read and the write-back to force the lost update
+    deterministically; production-shaped code never replaces it."""
+
+    def __init__(self):
+        self.gate = _noop
+        self._hits: dict = {}
+        self._drained = 0
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(  # tpulint-expect: thread-escape
+            target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+
+    def ingest(self, key):
+        n = self._hits.get(key, 0)  # tpulint-expect: guarded-field
+        self.gate()
+        self._hits[key] = n + 1  # tpulint-expect: guarded-field
+
+    def drain(self):
+        total = 0
+        for k in list(self._hits):  # tpulint-expect: guarded-field
+            total += self._hits.pop(k, 0)  # tpulint-expect: guarded-field
+        self._drained += total  # tpulint-expect: guarded-field
+        return total
+
+    def _flush_loop(self):
+        while not self._stop:
+            self.drain()
+
+
+class LockedStatsPlane:
+    """Control: identical shape, one lock over every access — clean."""
+
+    def __init__(self):
+        self.gate = _noop
+        self._lock = threading.Lock()
+        self._hits: dict = {}
+        self._drained = 0
+        self._stop = False
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+
+    def ingest(self, key):
+        with self._lock:
+            n = self._hits.get(key, 0)
+            self.gate()
+            self._hits[key] = n + 1
+
+    def drain(self):
+        with self._lock:
+            total = 0
+            for k in list(self._hits):
+                total += self._hits.pop(k, 0)
+            self._drained += total
+            return total
+
+    def _flush_loop(self):
+        while not self._stop:
+            self.drain()

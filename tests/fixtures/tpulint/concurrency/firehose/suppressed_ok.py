@@ -1,0 +1,23 @@
+"""Suppression forms for the v3 rules: the same racy shapes as the
+positive fixtures, absorbed by inline `tpulint: disable` comments (so
+the suppression plumbing and the stale-suppression bookkeeping both see
+the new rule ids in use)."""
+import threading
+
+
+class SuppressedPlane:
+    def __init__(self):
+        self._level = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(  # tpulint: disable=thread-escape -- fixture: suppression form for the escape audit
+            target=self._spin, daemon=True)
+        self._thread.start()
+
+    def bump(self):
+        self._level += 1  # tpulint: disable=guarded-field -- fixture: suppression form for the race rule
+
+    def _spin(self):
+        for _ in range(3):
+            self.bump()

@@ -1,0 +1,37 @@
+"""Negatives for the escape audit: the StoreSnapshot pattern (frozen
+dataclass handed off whole) and an internally-locked object whose every
+mutating method guards itself."""
+import threading
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class HeadSnapshot:
+    slot: int
+    root: bytes
+
+
+class LockedTally:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts: dict = {}
+
+    def bump(self, key):
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + 1
+
+
+def _worker(payload):
+    return payload
+
+
+def publish(slot, root):
+    snap = HeadSnapshot(slot=slot, root=root)
+    threading.Thread(target=_worker, args=(snap,), daemon=True).start()
+    return snap
+
+
+def spawn_locked():
+    tally = LockedTally()
+    threading.Thread(target=_worker, args=(tally,), daemon=True).start()
+    return tally

@@ -1,0 +1,22 @@
+"""Positive: a mutable, unlocked object handed to a thread target via
+`args` — the owner keeps a reference and may mutate concurrently."""
+import threading
+
+
+class MutableTally:
+    def __init__(self):
+        self.counts: dict = {}
+
+    def bump(self, key):
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+
+def _worker(tally):
+    return tally
+
+
+def spawn_worker():
+    tally = MutableTally()
+    threading.Thread(  # tpulint-expect: thread-escape
+        target=_worker, args=(tally,), daemon=True).start()
+    return tally

@@ -1,0 +1,17 @@
+"""Cross-module half B: `resync` holds the head lock and calls back into
+chain_queue.enqueue, which acquires the queue lock — closing the cycle
+that chain_queue.flush opens in the other direction."""
+import threading
+
+_head_lock = threading.Lock()
+
+
+def recompute(batch):
+    with _head_lock:  # tpulint-expect: lock-order
+        return len(batch)
+
+
+def resync(batch):
+    from . import chain_queue
+    with _head_lock:  # tpulint-expect: lock-order
+        return chain_queue.enqueue(batch)

@@ -1,0 +1,21 @@
+"""Cross-module half A of an ordering cycle: `flush` holds the queue
+lock and calls into chain_head, which acquires the head lock — the
+reverse chain lives in chain_head.resync. Neither module sees the whole
+cycle; only the callgraph does (the firehose→sched flush shape)."""
+import threading
+
+from . import chain_head
+
+_queue_lock = threading.Lock()
+
+
+def flush(batch):
+    # the queue->head edge this opens is anchored (and flagged) at the
+    # acquire inside chain_head.recompute, where the cycle becomes visible
+    with _queue_lock:
+        return chain_head.recompute(batch)
+
+
+def enqueue(batch):
+    with _queue_lock:
+        return list(batch)

@@ -1,0 +1,19 @@
+"""Positive: a two-lock ordering cycle inside one module — `admit` takes
+ingest-then-flush, `reconcile` takes flush-then-ingest. Whichever thread
+wins the first lock of each pair can deadlock the other."""
+import threading
+
+_ingest_lock = threading.Lock()
+_flush_lock = threading.Lock()
+
+
+def admit(batch):
+    with _ingest_lock:
+        with _flush_lock:  # tpulint-expect: lock-order
+            return list(batch)
+
+
+def reconcile(batch):
+    with _flush_lock:
+        with _ingest_lock:  # tpulint-expect: lock-order
+            return list(batch)

@@ -1,0 +1,32 @@
+"""Self-acquisition: a non-reentrant Lock re-acquired through a callee
+deadlocks instantly (positive); the same shape over an RLock is the
+intended re-entry idiom (negative) — the StoreMirror pattern."""
+import threading
+
+
+class NonReentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0
+
+    def outer(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._lock:  # tpulint-expect: lock-order
+            self.depth += 1
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.depth = 0
+
+    def outer(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._lock:
+            self.depth += 1

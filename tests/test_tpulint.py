@@ -308,8 +308,10 @@ def test_cli_list_rules():
     for rule_id in ("jit-purity", "dtype-pin", "donation-alias",
                     "import-layering", "no-scatter", "recompile-risk",
                     "donation-flow", "seam-coverage", "host-sync",
+                    "lock-order", "guarded-field", "thread-escape",
                     "stale-suppression"):
         assert rule_id in res.stdout
+    assert len(res.stdout.strip().splitlines()) == 13
 
 
 def test_cli_rules_subset():
@@ -376,3 +378,95 @@ def test_cli_since_rejects_write_baseline():
     res = _run_cli("--since", "HEAD", "--write-baseline")
     assert res.returncode == 2
     assert "incompatible" in res.stderr
+
+
+def test_cli_since_filters_concurrency_findings(tmp_path, monkeypatch, capsys):
+    """--since must scope the v3 concurrency findings the same way it scopes
+    the single-threaded rules: committed-clean reports nothing, and touching
+    only the racy module surfaces that module's guarded-field/thread-escape
+    findings without dragging in the clean one."""
+    proj = tmp_path / "proj"
+    plane = proj / "firehose"
+    plane.mkdir(parents=True)
+    racy = (
+        "import threading\n\n\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._level = 0\n"
+        "        self._t = None\n\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._spin, daemon=True)\n"
+        "        self._t.start()\n\n"
+        "    def bump(self):\n"
+        "        self._level += 1\n\n"
+        "    def _spin(self):\n"
+        "        for _ in range(3):\n"
+        "            self.bump()\n")
+    (plane / "racy.py").write_text(racy)
+    (plane / "clean.py").write_text("def f():\n    return 1\n")
+    _git(proj, "init", "-q")
+    _git(proj, "add", "-A")
+    _git(proj, "commit", "-q", "-m", "seed")
+
+    cli = _load_tpulint_cli()
+    monkeypatch.setattr(cli, "REPO", proj)
+
+    assert cli.main([str(plane), "--no-baseline", "--since", "HEAD"]) == 0
+    capsys.readouterr()
+
+    (plane / "racy.py").write_text(racy + "\n\ndef touched():\n    return 2\n")
+    assert cli.main([str(plane), "--no-baseline", "--since", "HEAD"]) == 1
+    out = capsys.readouterr().out
+    assert "guarded-field" in out and "thread-escape" in out
+    assert "clean.py" not in out
+
+
+# --- SARIF output + runtime guard --------------------------------------------
+
+def test_sarif_round_trips_with_json(tmp_path):
+    """--sarif and --json must describe the IDENTICAL (rule, file, line)
+    set — the SARIF lane feeding PR annotations may never drift from the
+    JSON artifact CI archives."""
+    sarif_path = tmp_path / "out.sarif"
+    res = _run_cli("--no-baseline", "--json", "--sarif", str(sarif_path),
+                   str(FIXTURES / "concurrency"))
+    assert res.returncode == 1, res.stdout + res.stderr
+    report = json.loads(res.stdout)
+    sarif = json.loads(sarif_path.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    from_json = {(f["rule"], f["path"], f["line"])
+                 for f in report["findings"]}
+    from_sarif = {
+        (r["ruleId"],
+         r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+         r["locations"][0]["physicalLocation"]["region"]["startLine"])
+        for r in run["results"]}
+    assert from_sarif == from_json and from_json
+    # driver metadata covers every active rule, including the v3 trio
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"lock-order", "guarded-field", "thread-escape"} <= rule_ids
+    # --no-baseline: everything is new
+    assert all(r["baselineState"] == "new" for r in run["results"])
+
+
+def test_json_reports_per_rule_timings():
+    res = _run_cli("--no-baseline", "--json", str(FIXTURES / "concurrency"))
+    report = json.loads(res.stdout)
+    assert report["elapsed_s"] >= 0
+    timed = set(report["timings_s"])
+    assert {"lock-order", "guarded-field", "thread-escape",
+            "analysis-context"} <= timed
+    assert all(v >= 0 for v in report["timings_s"].values())
+
+
+def test_max_seconds_guard():
+    """The lint-runtime ratchet: a run that outlives --max-seconds fails
+    even when its findings are clean, so fixpoint cost can't creep
+    invisibly; a generous budget passes."""
+    clean = str(FIXTURES / "suppressed")
+    res = _run_cli("--no-baseline", "--max-seconds", "600", clean)
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = _run_cli("--no-baseline", "--max-seconds", "0.000001", clean)
+    assert res.returncode == 1
+    assert "--max-seconds" in res.stderr

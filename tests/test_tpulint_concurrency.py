@@ -1,0 +1,184 @@
+"""tpulint v3 cross-validation: static concurrency rules vs a live race.
+
+The contract mirrors the recompile-risk precedent: the fixture corpus
+under tests/fixtures/tpulint/concurrency/ must match its inline
+expectations EXACTLY (both directions), the planted race in
+firehose/planted.py must be flagged by guarded-field inference AND
+reproduced — deterministically, via the fixture's `gate` interleaving
+seam — by the barrier-synchronized stress harness below, and the
+LockedStatsPlane control (same shape, one lock) must be BOTH statically
+clean and dynamically loss-free under a seeded hammer loop. Finally the
+shipped production planes themselves must come back clean: every real
+finding the v3 bootstrap surfaced was fixed in-tree, not baselined.
+"""
+import importlib.util
+import random
+import sys
+import threading
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "tpulint" / "concurrency"
+
+sys.path.insert(0, str(REPO))
+
+from consensus_specs_tpu.analysis import analyze_paths  # noqa: E402
+from consensus_specs_tpu.analysis.runner import rule_by_id  # noqa: E402
+
+CONCURRENCY_RULES = ("lock-order", "guarded-field", "thread-escape")
+
+
+def _rules():
+    return tuple(rule_by_id(r) for r in CONCURRENCY_RULES)
+
+
+def _expected_annotations(path: Path) -> set:
+    out = set()
+    for i, line in enumerate(path.read_text().splitlines(), start=1):
+        if "tpulint-expect:" not in line:
+            continue
+        for rule in line.split("tpulint-expect:")[1].split("--")[0].split(","):
+            out.add((path.name, i, rule.strip()))
+    return out
+
+
+# --- static: the corpus matches its annotations exactly ----------------------
+
+def test_concurrency_fixture_matches_annotations():
+    expected = set()
+    for f in sorted(FIXTURES.rglob("*.py")):
+        if "__pycache__" not in f.parts:
+            expected |= _expected_annotations(f)
+    result = analyze_paths([FIXTURES])
+    got = {(Path(f.path).name, f.line, f.rule) for f in result.findings}
+    assert got == expected, (
+        f"missed={sorted(expected - got)} unexpected={sorted(got - expected)}")
+    assert {r for _, _, r in expected} == set(CONCURRENCY_RULES)
+
+
+def test_planted_race_flagged_statically():
+    """Guarded-field must flag every unguarded `_hits`/`_drained` access in
+    RacyStatsPlane, while the LockedStatsPlane control — the same shape plus
+    one lock — contributes nothing."""
+    result = analyze_paths([FIXTURES / "firehose" / "planted.py"], _rules())
+    lines = (FIXTURES / "firehose" / "planted.py").read_text().splitlines()
+    control_start = next(i for i, l in enumerate(lines, 1)
+                         if "class LockedStatsPlane" in l)
+    racy = [f for f in result.findings if f.rule == "guarded-field"]
+    assert len(racy) == 5  # ingest read+write, drain scan+pop, drained +=
+    assert all("RacyStatsPlane" in f.message for f in racy)
+    assert all(f.line < control_start for f in result.findings)
+
+
+def test_shipped_thread_shapes_stay_clean():
+    """The two production thread shapes — double-buffered flusher hand-off
+    and subscriber callbacks delivered post-lock — are negative cases; the
+    rules must not regress into flagging them."""
+    for name in ("flusher_ok.py", "callback_ok.py"):
+        result = analyze_paths([FIXTURES / "firehose" / name], _rules())
+        assert result.findings == [], [f.format() for f in result.findings]
+
+
+def test_lock_order_cycle_and_self_deadlock():
+    result = analyze_paths([FIXTURES / "sched"], _rules())
+    by_file: dict = {}
+    for f in result.findings:
+        assert f.rule == "lock-order"
+        by_file.setdefault(Path(f.path).name, []).append(f)
+    # the same-module inversion: both halves of the cycle anchored
+    assert len(by_file["order_pos.py"]) == 2
+    # the cross-module chain: the cycle only exists through the callgraph
+    assert len(by_file["chain_head.py"]) == 2
+    assert all("cycle" in f.message for f in by_file["chain_head.py"])
+    # non-reentrant self-acquisition is its own finding; the RLock twin is not
+    reentry = by_file["reentry.py"]
+    assert len(reentry) == 1 and "deadlocks" in reentry[0].message
+    assert "NonReentrant" in reentry[0].message
+
+
+def test_thread_escape_positive_and_negatives():
+    pos = analyze_paths([FIXTURES / "forkchoice" / "escape_pos.py"], _rules())
+    assert [f.rule for f in pos.findings] == ["thread-escape"]
+    assert "MutableTally" in pos.findings[0].message
+    neg = analyze_paths([FIXTURES / "forkchoice" / "escape_ok.py"], _rules())
+    assert neg.findings == [], [f.format() for f in neg.findings]
+
+
+def test_suppression_forms_absorbed():
+    """The disable pragmas for the new rule ids must absorb (and count) the
+    seeded findings, and must not go stale (they were used this run)."""
+    result = analyze_paths([FIXTURES / "firehose" / "suppressed_ok.py"])
+    assert result.findings == [], [f.format() for f in result.findings]
+    assert result.suppressed == 2
+
+
+def test_production_planes_clean():
+    """The acceptance gate: zero unfixed concurrency findings in the shipped
+    package — the StoreMirror RLock, the breaker lock, the registry read
+    locks, and the firehose post-lock capture are all load-bearing here."""
+    result = analyze_paths([REPO / "consensus_specs_tpu"], _rules())
+    assert result.findings == [], [f.format() for f in result.findings]
+
+
+# --- dynamic: the planted race loses real updates ----------------------------
+
+def _load_planted():
+    spec = importlib.util.spec_from_file_location(
+        "_tpulint_planted_fixture", FIXTURES / "firehose" / "planted.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _join(*threads):
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "stress-harness thread wedged"
+
+
+def test_planted_race_reproduced_deterministically():
+    """Barrier-synchronized hammer loop: each round parks BOTH ingest
+    threads inside the read→write-back window via the fixture's `gate`
+    seam, so both read the same count and one increment is lost — every
+    round, deterministically, not probabilistically. 2*ROUNDS ingests
+    land as exactly ROUNDS."""
+    mod = _load_planted()
+    plane = mod.RacyStatsPlane()
+    rendezvous = threading.Barrier(2)
+    plane.gate = lambda: rendezvous.wait(timeout=10.0)
+    rounds = 25
+    for _ in range(rounds):
+        t1 = threading.Thread(target=plane.ingest, args=("k",))
+        t2 = threading.Thread(target=plane.ingest, args=("k",))
+        t1.start()
+        t2.start()
+        _join(t1, t2)
+    assert plane._hits["k"] == rounds  # half the updates lost to the race
+
+
+def test_locked_control_conserves_updates():
+    """The same hammer against LockedStatsPlane — with its flusher thread
+    live and draining concurrently — must conserve every update: the lock
+    is the only difference between this passing and the racy twin losing
+    half its increments. Seeded keys keep the interleaving pressure
+    reproducible run to run."""
+    mod = _load_planted()
+    plane = mod.LockedStatsPlane()
+    plane.start()
+    rng = random.Random(0xC0FFEE)
+    keys = [f"k{rng.randrange(8)}" for _ in range(200)]
+    n_threads = 4
+    start_gate = threading.Barrier(n_threads)
+
+    def hammer():
+        start_gate.wait(timeout=10.0)
+        for key in keys:
+            plane.ingest(key)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    _join(*threads)
+    plane.stop()
+    plane.drain()  # fold any remainder into the drained total
+    assert plane._drained == n_threads * len(keys)

@@ -8,6 +8,8 @@ Usage:
         [--allow-growth]                          # explicit override for growth
         [--rules id1,id2]                         # subset of passes
         [--since <git-ref>]                       # report changed files only
+        [--sarif out.sarif]                       # SARIF 2.1.0 (PR annotations)
+        [--max-seconds N]                         # fail if the run takes longer
         [--list-rules] [--json] [--self-test]
 
 Exit codes: 0 clean (no findings beyond the baseline), 1 new findings (or
@@ -27,6 +29,7 @@ import dataclasses
 import json
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
@@ -78,6 +81,48 @@ def _changed_files(ref: str) -> set[str] | None:
             if line.strip().endswith(".py")}
 
 
+def _sarif_report(result, rules, new_set) -> dict:
+    """SARIF 2.1.0 document over the SAME findings list as --json: one
+    result per finding, `baselineState` distinguishing frozen-baseline
+    findings (unchanged) from new ones so PR annotation surfaces can hide
+    the former. Rule metadata comes from the live rule objects."""
+    level = {"error": "error", "warning": "warning"}
+    sarif_rules = [{
+        "id": r.id,
+        "shortDescription": {"text": r.doc},
+        "defaultConfiguration": {"level": level.get(r.severity, "note")},
+    } for r in rules]
+    results = []
+    for f in result.findings:
+        message = f.message + (f"  (fix: {f.hint})" if f.hint else "")
+        results.append({
+            "ruleId": f.rule,
+            "level": level.get(f.severity, "note"),
+            "message": {"text": message},
+            "baselineState": ("new" if (f.path, f.line, f.rule) in new_set
+                              else "unchanged"),
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line},
+                },
+            }],
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "rules": sarif_rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
 def _self_test() -> int:
     """Run every fixture root and compare against its inline expectations."""
     roots = sorted(p for p in FIXTURES.iterdir()
@@ -122,6 +167,9 @@ def main(argv=None) -> int:
     ap.add_argument("--allow-growth", action="store_true")
     ap.add_argument("--rules", default=None)
     ap.add_argument("--since", default=None, metavar="REF")
+    ap.add_argument("--sarif", default=None, metavar="FILE")
+    ap.add_argument("--max-seconds", default=None, type=float,
+                    metavar="N", dest="max_seconds")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--self-test", action="store_true")
@@ -154,7 +202,9 @@ def main(argv=None) -> int:
               "(the baseline must always describe a FULL run)", file=sys.stderr)
         return 2
 
+    t_start = time.perf_counter()
     result = analyze_paths(paths, rules)
+    elapsed = time.perf_counter() - t_start
     result.findings = [_canon(f) for f in result.findings]
 
     baseline_path = Path(args.baseline) if args.baseline else Path(DEFAULT_BASELINE)
@@ -194,6 +244,11 @@ def main(argv=None) -> int:
     new, fixed = (diff_against_baseline(result.findings, baseline)
                   if baseline else (result.findings, 0))
 
+    if args.sarif:
+        new_set = {(f.path, f.line, f.rule) for f in new}
+        doc = _sarif_report(result, rules, new_set)
+        Path(args.sarif).write_text(json.dumps(doc, indent=1) + "\n")
+
     if args.as_json:
         report = {
             "files": result.file_count,
@@ -201,6 +256,9 @@ def main(argv=None) -> int:
             "new": [f.as_json() for f in new],
             "suppressed": result.suppressed,
             "fixed_vs_baseline": fixed,
+            "elapsed_s": round(elapsed, 3),
+            "timings_s": {k: round(v, 4)
+                          for k, v in sorted(result.timings_s.items())},
         }
         if args.since:
             report["since"] = args.since
@@ -217,6 +275,12 @@ def main(argv=None) -> int:
         if baseline and fixed:
             print("tpulint: baseline entries were fixed — ratchet down with "
                   f"`python tools/tpulint.py --write-baseline` ({baseline_path})")
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"tpulint: run took {elapsed:.1f}s > --max-seconds "
+              f"{args.max_seconds:g} — the interprocedural fixpoints are "
+              "outgrowing the lint budget; profile with --json timings_s",
+              file=sys.stderr)
+        return 1
     return 1 if new else 0
 
 
