@@ -10,7 +10,7 @@ GENERATORS = bls ssz_generic ssz_static shuffling operations epoch_processing \
              sanity genesis finality rewards fork_choice forks transition \
              merkle random custody_sharding scenarios
 
-.PHONY: test testall citest testfast chaos sched msm firehose scenarios proofs forkchoice slo lint lint-fast pyspec generate_tests \
+.PHONY: test testall citest testfast chaos sched msm firehose scenarios proofs forkchoice frontdoor slo lint lint-fast pyspec generate_tests \
         clean_vectors detect_generator_incomplete bench bench_quick \
         bench-probe graft_check native replay random_codegen coverage \
         deposit_contract_json
@@ -133,6 +133,20 @@ forkchoice:
 	timeout -k 10 600 $(PYTHON) -m pytest \
 	    tests/test_forkchoice.py -q -m "not slow"
 	$(PYTHON) tools/obs_dump.py check test-results/obs_forkchoice.json
+
+# Front-door admission lane: the unified admission plane over the four
+# service lanes (frontdoor/ + the scheduler's EDF seal-policy seam) —
+# per-tenant quotas, the shed ladder, deadline sealing, and the three
+# seeded traffic profiles replayed bit-identically under chaos — see
+# README "Front door". Obs snapshot validated like the sibling lanes;
+# the frontdoor_* series are the artifact.
+frontdoor:
+	mkdir -p test-results
+	OBS_SNAPSHOT=test-results/obs_frontdoor.json OBS_SNAPSHOT_LANE=frontdoor \
+	OBS_FLIGHT_DIR=test-results \
+	timeout -k 10 600 $(PYTHON) -m pytest \
+	    tests/test_frontdoor.py -q -m "not slow"
+	$(PYTHON) tools/obs_dump.py check test-results/obs_frontdoor.json
 
 # Declarative SLO gate (slo.json at the repo root): the bench trajectory
 # and obs-snapshot invariants as machine-checked objectives — see README

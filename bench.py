@@ -244,6 +244,10 @@ def run_benches() -> dict:
             import benches.forkchoice_bench as forkchoice_bench
 
             fc_r = forkchoice_bench.run()
+        with timed("bench_frontdoor"):
+            import benches.frontdoor_bench as frontdoor_bench
+
+            fd_r = frontdoor_bench.run()
     if profile_dir:
         print(f"# device trace written to {profile_dir}", file=sys.stderr)
     print(f"# stage timings: {timings()}", file=sys.stderr)
@@ -380,6 +384,20 @@ def run_benches() -> dict:
                 fc_r["forkchoice_vs_host_speedup"],
             "forkchoice_blocks": fc_r["forkchoice_blocks"],
             "forkchoice_validators": fc_r["forkchoice_validators"],
+            # front-door admission plane: the three seeded traffic
+            # profiles replayed un-paced on the real clock; the
+            # hostile-tenant lane's worst HONEST p99 (from the door's own
+            # per-tenant histogram) is the SLO series, and the
+            # attestation-shed count sums every round of every profile —
+            # the writes-never-shed invariant, gated at zero
+            "frontdoor_requests_per_s": fd_r["frontdoor_requests_per_s"],
+            "frontdoor_hostile_honest_p99_s":
+                fd_r["frontdoor_hostile_honest_p99_s"],
+            "frontdoor_attestation_sheds":
+                fd_r["frontdoor_attestation_sheds"],
+            "frontdoor_mallory_quota_refusals":
+                fd_r["frontdoor_mallory_quota_refusals"],
+            "frontdoor_profiles": fd_r["frontdoor_profiles"],
             # per-slot state root at registry scale (incremental Merkle)
             "state_root_slot_s": sr["slot_root_s"],
             "state_root_block_s": sr["block_root_s"],

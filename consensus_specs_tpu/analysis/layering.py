@@ -36,7 +36,7 @@ class LayeringConfig:
     jax_free: tuple[str, ...] = (
         "evm/", "crypto/bls.py", "crypto/kzg.py", "crypto/kzg_shim.py",
         "crypto/das.py", "robustness/", "obs/", "sched/", "firehose/",
-        "scenarios/", "proofs/", "forkchoice/",
+        "scenarios/", "proofs/", "forkchoice/", "frontdoor/",
     )
     # (importer pattern, forbidden import pattern) over module paths
     forbidden: tuple[tuple[str, str], ...] = (("ops/", "engine/"),)

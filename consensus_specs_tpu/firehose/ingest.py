@@ -41,6 +41,13 @@ class AttestationItem:
     # installed (None otherwise — classifiers never mint). Rides the item
     # into the sched Request so the dispatch span can link back to it.
     trace: Optional[TraceContext] = None
+    # QoS attribution, stamped by the admission plane (frontdoor/), never
+    # by classifiers: the owning tenant (per-tenant quota + p99 series)
+    # and the absolute verdict deadline that feeds the scheduler's EDF
+    # seal policy via Request.deadline. Both default off, so pre-frontdoor
+    # callers are byte-identical to before.
+    tenant: Optional[str] = None
+    deadline: Optional[float] = None
 
 
 def beacon_classifier(spec, state):

@@ -181,17 +181,52 @@ class AttestationFirehose:
         malformed, or shed under backpressure)."""
         return self.offer_many([ssz_bytes]) == 1
 
-    def offer_many(self, payloads) -> int:
+    def offer_many(self, payloads, *, tenant: str | None = None) -> int:
         """Ingest a micro-batch: classify/dedup each payload, then admit
-        the survivors through one batched aggregation pass."""
+        the survivors through one batched aggregation pass. `tenant` tags
+        every admitted item for per-tenant QoS attribution (frontdoor)."""
         items = []
         for ssz in payloads:
-            item = self._ingest_one(bytes(ssz))
+            item = self._ingest_one(bytes(ssz), tenant=tenant)
             if item is not None:
                 items.append(item)
         return self._aggregate_many(items)
 
-    def _ingest_one(self, raw: bytes):
+    # -- admission-plane seams (frontdoor/) --------------------------------
+
+    def ingest_one(self, ssz_bytes: bytes, *, tenant: str | None = None):
+        """Classify + dedup ONE payload without aggregating it: the
+        admission plane's two-phase entry. Returns the AttestationItem
+        (dedup slot now held) or None (duplicate/malformed). The caller
+        either follows through with `admit_items` or — if it sheds the
+        request instead — MUST `release` the msg_id so a re-offer after
+        the Overloaded verdict can land."""
+        return self._ingest_one(bytes(ssz_bytes), tenant=tenant)
+
+    def admit_items(self, items) -> int:
+        """Aggregate already-ingested items (from `ingest_one`); returns
+        the number admitted under the backpressure bound."""
+        return self._aggregate_many(list(items))
+
+    def release(self, msg_ids) -> int:
+        """Release dedup slots for shed requests: a front-door shed fails
+        the caller fast, but the NEXT gossip of the same attestation must
+        be a fresh admission, not a duplicate. Returns the number of slots
+        actually released (already-evicted ids are a no-op)."""
+        released = 0
+        with self._lock:
+            for msg_id in msg_ids:
+                # _seen stores None values (FIFO-ordered set): presence,
+                # not the popped value, is the release signal
+                if msg_id in self._seen:
+                    del self._seen[msg_id]
+                    released += 1
+        if released:
+            self.registry.counter("firehose_dedup_released_total").inc(
+                released)
+        return released
+
+    def _ingest_one(self, raw: bytes, *, tenant: str | None = None):
         reg = self.registry
         # Mint the request's causal identity here — ingest IS the birth of
         # a request — but only under an installed tracer, preserving the
@@ -218,7 +253,10 @@ class AttestationFirehose:
                     self._seen.pop(next(iter(self._seen)))
                     reg.counter("firehose_dedup_evictions_total").inc()
             reg.counter("firehose_ingested_total").inc()
-            return item if ctx is None else replace(item, trace=ctx)
+            if ctx is None and tenant is None:
+                return item
+            return replace(item, trace=ctx if ctx is not None else item.trace,
+                           tenant=tenant)
 
     # -- arrival-rate tracking ---------------------------------------------
 
@@ -312,7 +350,8 @@ class AttestationFirehose:
                         Request(work_class="bls", kind="fast_aggregate",
                                 payload=(list(it.pubkeys), it.message,
                                          it.signature),
-                                group_key=it.key, trace=it.trace)
+                                group_key=it.key, trace=it.trace,
+                                deadline=it.deadline)
                         for it in chunk])
 
                 try:

@@ -43,6 +43,12 @@ class ForkChoiceService:
         self._store = None
         self._latest: dict = {}   # direct-drive latest messages
         self._lock = threading.Lock()
+        # (root, t_monotonic) of the most recent computed head; published
+        # with a single GIL-atomic store from head() — note_verified calls
+        # head() while holding _lock, so the cache cannot take it — and
+        # read the same way by last_head() (the init-publication /
+        # publish-store idiom the concurrency lint sanctions).
+        self._head_cache: tuple | None = None
         self._head_lag = self.registry.histogram(
             "forkchoice_head_lag_seconds")
         self._heads = self.registry.counter("forkchoice_heads_total")
@@ -99,7 +105,22 @@ class ForkChoiceService:
 
     def head(self) -> bytes:
         """Current head root (32 bytes)."""
-        return self.mirror.root_at(self.head_index())
+        root = self.mirror.root_at(self.head_index())
+        self._head_cache = (root, time.monotonic())
+        return root
+
+    def last_head(self) -> bytes | None:
+        """STALE read: the most recently computed head, without touching
+        the device lane — the shed ladder's head-query fallback
+        (frontdoor). None until the first head() lands; staleness is the
+        caller's bargain (age is available via last_head_age_s)."""
+        cached = self._head_cache
+        return cached[0] if cached is not None else None
+
+    def last_head_age_s(self) -> float | None:
+        """Seconds since the cached head was computed (None: no head yet)."""
+        cached = self._head_cache
+        return (time.monotonic() - cached[1]) if cached is not None else None
 
     # --- firehose consumer seam --------------------------------------------
 

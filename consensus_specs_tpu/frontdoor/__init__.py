@@ -1,0 +1,68 @@
+"""Beacon-API front door: the unified admission plane.
+
+One layer fronts the write lane (firehose/), the read lane (proofs/),
+and the head lane (forkchoice/) with the decisions none of them can make
+alone: priority classes (block-proposal > attestation-verify >
+head-query > light-client-read), per-tenant token-bucket quotas on an
+injected clock, deadline-aware EDF flush sealing via the scheduler's
+seal-policy seam, and a load-shed ladder that degrades reads before
+writes and fails fast with a typed `Overloaded` that releases firehose
+dedup. See admission.FrontDoor; traffic.py holds the seeded replay
+profiles (diurnal / flash_crowd / hostile_tenant) the SLO gate runs.
+
+jax-free at module level by charter (tpulint import-layering): the
+device is only reached through the fronted lanes' sched submits.
+"""
+from .admission import (
+    ADMIT_RETRY_POLICY,
+    FrontDoor,
+    FrontDoorConfig,
+    Ticket,
+)
+from .qos import (
+    ATTESTATION_VERIFY,
+    BLOCK_PROPOSAL,
+    CLASSES,
+    HEAD_QUERY,
+    LIGHT_CLIENT_READ,
+    PRIORITY,
+    SHEDDABLE,
+    Overloaded,
+    TenantQuotas,
+    TokenBucket,
+)
+from .traffic import (
+    PROFILES,
+    TrafficScript,
+    TrafficStep,
+    VirtualClock,
+    build_script,
+    outcome,
+    outcomes,
+    replay,
+)
+
+__all__ = [
+    "ADMIT_RETRY_POLICY",
+    "ATTESTATION_VERIFY",
+    "BLOCK_PROPOSAL",
+    "CLASSES",
+    "FrontDoor",
+    "FrontDoorConfig",
+    "HEAD_QUERY",
+    "LIGHT_CLIENT_READ",
+    "Overloaded",
+    "PRIORITY",
+    "PROFILES",
+    "SHEDDABLE",
+    "TenantQuotas",
+    "Ticket",
+    "TokenBucket",
+    "TrafficScript",
+    "TrafficStep",
+    "VirtualClock",
+    "build_script",
+    "outcome",
+    "outcomes",
+    "replay",
+]

@@ -1,0 +1,453 @@
+"""FrontDoor: the unified admission plane over the four service lanes.
+
+One object fronts everything a beacon-API deployment exposes — the write
+lane (AttestationFirehose), the read lane (ProofService), the head lane
+(ForkChoiceService), and block-proposal head queries — and makes the
+decisions none of the lanes can make alone:
+
+  admission   `submit(tenant, klass, payload)` runs the gate in a fixed
+              order: fault seam (`frontdoor.admit`, retry-absorbed) →
+              expired-deadline fast-fail → dedup (attestations only —
+              duplicates never burn quota) → per-tenant token bucket →
+              the pressure shed ladder. Survivors are queued (reads,
+              heads) or handed to the firehose (writes) with the
+              effective deadline stamped into the Request, which is what
+              the scheduler's EdfSealPolicy seals on.
+
+  priority    block_proposal > attestation_verify > head_query >
+              light_client_read (qos.PRIORITY), enforced at pump order,
+              at the shed ladder (reads degrade first, writes never), and
+              at flush sealing via the scheduler's class_priority.
+
+  shedding    pressure = firehose backlog + the door's own queues. At
+              `shed_reads_at` light-client reads shed; at `shed_heads_at`
+              head queries shed too; attestation-verify and
+              block-proposal NEVER pressure-shed. A shed resolves fast
+              with a typed Overloaded — and for attestations releases the
+              firehose dedup slot, so the next gossip of the same message
+              is a fresh admission. Callers that opted into degraded
+              reads get the host fallback instead: `prove_host` branches
+              (bit-identical to the device lane) or the last cached head
+              (stale by contract). Fault seam: `frontdoor.shed`.
+
+  attribution the admission span carries a `tenant` label, and every
+              counter/histogram worth slicing per tenant is so labelled —
+              `frontdoor_admission_to_result_seconds{tenant=...}` is the
+              series the hostile-tenant p99 SLO gates.
+
+Determinism: the door takes an injected `clock`; with a virtual clock
+(traffic.VirtualClock) every quota refill, deadline comparison, and EDF
+seal decision is a pure function of the submitted script, which is what
+lets the chaos lanes assert bit-identical outcomes against the fault-free
+oracle replay.
+
+jax-free at module level by charter (tpulint import-layering): the device
+is only ever reached through the lanes' own sched submits.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
+from ..parallel.gossip_driver import message_id as _message_id
+from ..robustness import faults as _faults
+from ..robustness import retry as _retry
+from .qos import (
+    ATTESTATION_VERIFY,
+    BLOCK_PROPOSAL,
+    HEAD_QUERY,
+    LIGHT_CLIENT_READ,
+    PRIORITY,
+    Overloaded,
+    TenantQuotas,
+)
+
+_PENDING = object()
+
+# Default admission retry budget: transient faults at the admission seams
+# are absorbed without changing the admission decision (the chaos
+# convergence contract); zero backoff keeps the door's latency its own.
+ADMIT_RETRY_POLICY = _retry.RetryPolicy(
+    max_attempts=4, base_delay=0.0, backoff=1.0, max_delay=0.0, jitter=0.0)
+
+
+@dataclass(frozen=True)
+class FrontDoorConfig:
+    """Admission-plane knobs. Deadlines are per-class DEFAULTS (seconds of
+    budget from admission; an explicit submit deadline wins); the shed
+    thresholds are pressure levels — outstanding requests between
+    admission and verdict — at which each rung of the read ladder sheds."""
+
+    deadline_s: dict = field(default_factory=lambda: {
+        BLOCK_PROPOSAL: 0.35,
+        ATTESTATION_VERIFY: 1.0,
+        HEAD_QUERY: 0.5,
+        LIGHT_CLIENT_READ: 2.0,
+    })
+    shed_reads_at: int = 192    # rung 1: light_client_read sheds
+    shed_heads_at: int = 384    # rung 2: head_query sheds too
+    seal_slack_s: float = 0.01  # EDF slack handed to the seal policy
+    # write lane high-water: pump the firehose before admission would push
+    # its backlog past this, so the write path exerts backpressure through
+    # WORK, never through drops (the zero-attestation-sheds invariant)
+    write_pump_at: int = 1024
+
+    def __post_init__(self):
+        missing = [k for k in PRIORITY if k not in self.deadline_s]
+        if missing:
+            raise ValueError(f"deadline_s missing classes: {missing}")
+        if self.shed_heads_at < self.shed_reads_at:
+            raise ValueError("shed_heads_at must be >= shed_reads_at "
+                             "(reads shed BEFORE heads)")
+
+
+class Ticket:
+    """Single-assignment future for one admitted (or refused) request.
+
+    `result()` drives the door's pump until the verdict lands; a refusal
+    resolves the ticket with the Overloaded value itself (typed fast-fail,
+    not an exception — the caller branches on `overloaded()`)."""
+
+    __slots__ = ("tenant", "klass", "payload", "deadline", "degraded_ok",
+                 "t_submit", "_door", "_value")
+
+    def __init__(self, door, tenant, klass, payload, deadline, degraded_ok,
+                 t_submit):
+        self._door = door
+        self.tenant = tenant
+        self.klass = klass
+        self.payload = payload
+        self.deadline = deadline
+        self.degraded_ok = degraded_ok
+        self.t_submit = t_submit
+        self._value = _PENDING
+
+    def done(self) -> bool:
+        return self._value is not _PENDING
+
+    def overloaded(self) -> bool:
+        return isinstance(self._value, Overloaded)
+
+    def result(self, pumps: int = 64):
+        for _ in range(pumps):
+            if self.done():
+                return self._value
+            self._door.pump()
+        if not self.done():
+            raise RuntimeError(
+                f"frontdoor ticket {self.klass}/{self.tenant} still pending "
+                f"after {pumps} pumps")
+        return self._value
+
+
+class FrontDoor:
+    """The admission plane instance fronting one set of service lanes."""
+
+    def __init__(self, *, firehose, proofs, forkchoice, scheduler,
+                 quotas: TenantQuotas | None = None,
+                 config: FrontDoorConfig | None = None,
+                 retry_policy: _retry.RetryPolicy | None = None,
+                 clock=time.monotonic, registry=None):
+        self.firehose = firehose
+        self.proofs = proofs
+        self.forkchoice = forkchoice
+        self.scheduler = scheduler
+        self.config = config or FrontDoorConfig()
+        self.clock = clock
+        self.registry = (registry if registry is not None
+                         else _obs_metrics.REGISTRY)
+        self.quotas = (quotas if quotas is not None
+                       else TenantQuotas(clock=clock))
+        self.retry_policy = retry_policy or ADMIT_RETRY_POLICY
+        self._lock = threading.Lock()
+        # door-owned queues: reads and head queries wait here between
+        # admission and pump; attestations live in the firehose instead
+        self._queues: dict = {BLOCK_PROPOSAL: [], HEAD_QUERY: [],
+                              LIGHT_CLIENT_READ: []}
+        self._att_tickets: dict = {}  # msg_id -> [Ticket, ...]
+        firehose.subscribe_verified(self._on_verified)
+
+    # -- construction helper -------------------------------------------------
+
+    @classmethod
+    def build(cls, classifier, *, work_classes, clock=time.monotonic,
+              registry=None, config=None, quotas=None,
+              retry_policy=None, sched_retry_policy=None,
+              firehose_config=None, scheduler_max_depth: int = 8192):
+        """Wire a full stack behind one door: a shared Scheduler carrying
+        the EDF seal policy + priority ranks + the door's clock, an INLINE
+        (threaded=False, deterministic) firehose, a ProofService, and a
+        ForkChoiceService, all on the same scheduler and registry."""
+        from ..firehose import AttestationFirehose, FirehoseConfig
+        from ..forkchoice import ForkChoiceService
+        from ..proofs import ProofService
+        from ..sched import EdfSealPolicy, Scheduler
+
+        cfg = config or FrontDoorConfig()
+        reg = registry if registry is not None else _obs_metrics.REGISTRY
+        scheduler = Scheduler(
+            classes=work_classes,
+            retry_policy=sched_retry_policy,
+            max_depth=scheduler_max_depth,
+            seal_policy=EdfSealPolicy(slack_s=cfg.seal_slack_s),
+            # sched class names ranked like the door classes they serve:
+            # the write lane first, the head lane next, reads last
+            class_priority={"bls": 0, "forkchoice": 1, "merkle": 2},
+            clock=clock, registry=reg)
+        firehose = AttestationFirehose(
+            classifier, config=firehose_config or FirehoseConfig(),
+            scheduler=scheduler, registry=reg,
+            retry_policy=retry_policy, threaded=False)
+        proofs = ProofService(scheduler=scheduler, registry=reg)
+        forkchoice = ForkChoiceService(scheduler=scheduler, registry=reg)
+        return cls(firehose=firehose, proofs=proofs, forkchoice=forkchoice,
+                   scheduler=scheduler, quotas=quotas, config=cfg,
+                   retry_policy=retry_policy, clock=clock, registry=reg)
+
+    # -- pressure ------------------------------------------------------------
+
+    def pressure(self) -> int:
+        """Outstanding requests between admission and verdict: the shed
+        ladder's input and the exported frontdoor_pressure gauge."""
+        with self._lock:
+            queued = sum(len(q) for q in self._queues.values())
+        p = queued + self.firehose.pending()
+        self.registry.gauge("frontdoor_pressure").set(p)
+        return p
+
+    def _depth_gauge(self, klass: str) -> None:
+        with self._lock:
+            depth = len(self._queues.get(klass, ()))
+        self.registry.gauge("frontdoor_queue_depth", klass=klass).set(depth)
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, tenant: str, klass: str, payload=None, *,
+               deadline: float | None = None,
+               degraded_ok: bool = False) -> Ticket:
+        """Admit one request; always returns a Ticket (refusals resolve it
+        with a typed Overloaded — the door never raises for load)."""
+        if klass not in PRIORITY:
+            raise ValueError(f"unknown request class {klass!r} "
+                             f"(classes: {sorted(PRIORITY)})")
+        now = self.clock()
+        eff_deadline = (deadline if deadline is not None
+                        else now + self.config.deadline_s[klass])
+        ticket = Ticket(self, tenant, klass, payload, eff_deadline,
+                        degraded_ok, now)
+        with _obs_trace.span("frontdoor.admit", tenant=tenant, klass=klass):
+
+            def _admit_seam():
+                _faults.fire("frontdoor.admit")
+                return True
+
+            _retry.call_with_retry(_admit_seam, self.retry_policy)
+            if eff_deadline <= now:
+                return self._refuse(ticket, "deadline_missed")
+            if klass == ATTESTATION_VERIFY:
+                return self._admit_attestation(ticket, now)
+            if not self.quotas.take(tenant):
+                return self._refuse(ticket, "quota_exhausted")
+            shed = self._shed_rung(klass)
+            if shed:
+                return self._shed(ticket)
+            return self._enqueue(ticket)
+
+    def _admit_attestation(self, ticket: Ticket, now: float) -> Ticket:
+        # dedup FIRST: duplicates resolve from the known verdict (or hook
+        # onto the in-flight original) without burning the tenant's quota
+        raw = bytes(ticket.payload)
+        item = self.firehose.ingest_one(raw, tenant=ticket.tenant)
+        if item is None:
+            msg_id = _message_id(raw)
+            prior = self.firehose.results().get(msg_id)
+            if prior is not None:
+                return self._resolve(ticket, bool(prior))
+            with self._lock:
+                pending = self._att_tickets.get(msg_id)
+                if pending is not None:
+                    pending.append(ticket)
+                    return ticket
+            # malformed (quarantined by ingest) — or dedup-held by a
+            # non-door producer: not verified, not Overloaded
+            self.registry.counter("frontdoor_malformed_total").inc()
+            return self._resolve(ticket, False)
+        if not self.quotas.take(ticket.tenant):
+            # quota refusal must release the dedup slot: the tenant's NEXT
+            # gossip of this attestation (post-refill) is a fresh admission
+            self.firehose.release([item.msg_id])
+            return self._refuse(ticket, "quota_exhausted")
+        # keep the write lane's backpressure working-not-dropping: drain
+        # before the firehose bound would shed an attestation
+        if self.firehose.pending() >= self.config.write_pump_at:
+            self.firehose.drain()
+        item = replace(item, deadline=ticket.deadline)
+        admitted = self.firehose.admit_items([item])
+        if admitted != 1:
+            # the firehose itself shed at its hard bound (it released the
+            # dedup slot); surface it as a pressure shed, honestly counted
+            return self._refuse(ticket, "shed")
+        with self._lock:
+            self._att_tickets.setdefault(item.msg_id, []).append(ticket)
+        self.registry.counter("frontdoor_admitted_total",
+                              klass=ATTESTATION_VERIFY,
+                              tenant=ticket.tenant).inc()
+        return ticket
+
+    def _shed_rung(self, klass: str) -> bool:
+        """Does the CURRENT pressure shed this class? Reads first, heads
+        second, writes never — the ladder's one invariant."""
+        p = self.pressure()
+        if klass == LIGHT_CLIENT_READ:
+            return p >= self.config.shed_reads_at
+        if klass == HEAD_QUERY:
+            return p >= self.config.shed_heads_at
+        return False
+
+    def _enqueue(self, ticket: Ticket) -> Ticket:
+        with self._lock:
+            self._queues[ticket.klass].append(ticket)
+        self.registry.counter("frontdoor_admitted_total",
+                              klass=ticket.klass, tenant=ticket.tenant).inc()
+        self._depth_gauge(ticket.klass)
+        return ticket
+
+    # -- refusal / degradation ----------------------------------------------
+
+    def _refuse(self, ticket: Ticket, reason: str) -> Ticket:
+        reg = self.registry
+        if reason == "quota_exhausted":
+            reg.counter("frontdoor_quota_exhausted_total",
+                        tenant=ticket.tenant).inc()
+            retry_after = self.quotas.bucket(ticket.tenant).time_to_tokens()
+        elif reason == "deadline_missed":
+            reg.counter("frontdoor_deadline_missed_total",
+                        klass=ticket.klass).inc()
+            retry_after = 0.0
+        else:
+            reg.counter("frontdoor_shed_total", klass=ticket.klass,
+                        reason=reason).inc()
+            retry_after = self.config.seal_slack_s
+        return self._resolve(ticket, Overloaded(
+            reason=reason, klass=ticket.klass, tenant=ticket.tenant,
+            retry_after_s=retry_after))
+
+    def _shed(self, ticket: Ticket) -> Ticket:
+        """Pressure-shed one read-side request: degraded service when the
+        caller opted in, typed Overloaded otherwise. Either way the device
+        lanes never see it. Fault seam: `frontdoor.shed`."""
+        with _obs_trace.span("frontdoor.shed", tenant=ticket.tenant,
+                             klass=ticket.klass):
+
+            def _shed_seam():
+                _faults.fire("frontdoor.shed")
+                return True
+
+            _retry.call_with_retry(_shed_seam, self.retry_policy)
+            if ticket.degraded_ok:
+                if ticket.klass == LIGHT_CLIENT_READ:
+                    column, gindex = ticket.payload
+                    branch = self.proofs.prove_host(column, gindex)
+                    self.registry.counter("frontdoor_degraded_total",
+                                          klass=ticket.klass).inc()
+                    return self._resolve(ticket, branch)
+                if ticket.klass == HEAD_QUERY:
+                    stale = self.forkchoice.last_head()
+                    if stale is not None:
+                        self.registry.counter("frontdoor_degraded_total",
+                                              klass=ticket.klass).inc()
+                        return self._resolve(ticket, stale)
+            return self._refuse(ticket, "shed")
+
+    def _resolve(self, ticket: Ticket, value) -> Ticket:
+        ticket._value = value
+        self.registry.histogram(
+            "frontdoor_admission_to_result_seconds",
+            tenant=ticket.tenant).observe(
+                max(0.0, self.clock() - ticket.t_submit))
+        return ticket
+
+    # -- service (pump / drain) ----------------------------------------------
+
+    def pump(self) -> None:
+        """One service pass, priority-ordered: proposal heads, then the
+        write lane, then head queries, then the batched read lane. Within
+        a class, tickets serve earliest-deadline-first; a ticket served
+        past its deadline still gets its (late) value, counted in
+        frontdoor_deadline_missed_total."""
+        self._serve_heads(BLOCK_PROPOSAL)
+        if self.firehose.pending():
+            self.firehose.drain()
+        self._serve_heads(HEAD_QUERY)
+        self._serve_reads()
+
+    def drain(self, max_pumps: int = 64) -> None:
+        """Pump until nothing is outstanding."""
+        for _ in range(max_pumps):
+            if not self._outstanding():
+                return
+            self.pump()
+        raise RuntimeError("frontdoor drain did not settle: "
+                           f"{self._outstanding()} outstanding")
+
+    def _outstanding(self) -> int:
+        with self._lock:
+            queued = sum(len(q) for q in self._queues.values())
+            atts = sum(len(ts) for ts in self._att_tickets.values())
+        return queued + atts + self.firehose.pending()
+
+    def _take_queue(self, klass: str) -> list:
+        with self._lock:
+            tickets, self._queues[klass] = self._queues[klass], []
+        if tickets:
+            tickets.sort(key=lambda t: t.deadline)
+            self._depth_gauge(klass)
+        return tickets
+
+    def _note_late(self, ticket: Ticket, now: float) -> None:
+        if now > ticket.deadline:
+            self.registry.counter("frontdoor_deadline_missed_total",
+                                  klass=ticket.klass).inc()
+
+    def _serve_heads(self, klass: str) -> None:
+        tickets = self._take_queue(klass)
+        if not tickets:
+            return
+        # one device head serves every ticket taken in this pass — the
+        # head is a property of the store, not of the querier
+        root = self.forkchoice.head()
+        now = self.clock()
+        for t in tickets:
+            self._note_late(t, now)
+            self._resolve(t, root)
+
+    def _serve_reads(self) -> None:
+        tickets = self._take_queue(LIGHT_CLIENT_READ)
+        if not tickets:
+            return
+        branches = self.proofs.prove_many([t.payload for t in tickets])
+        now = self.clock()
+        for t, branch in zip(tickets, branches):
+            self._note_late(t, now)
+            self._resolve(t, branch)
+
+    # -- write-lane verdict fan-in -------------------------------------------
+
+    def _on_verified(self, records) -> None:
+        """firehose verified-batch subscriber: resolve every attestation
+        ticket whose verdict landed in this collect pass. Runs on the
+        resolving thread, outside the firehose lock."""
+        resolved = []
+        with self._lock:
+            for msg_id, _key, ok, _t in records:
+                tickets = self._att_tickets.pop(msg_id, None)
+                if tickets:
+                    resolved.append((tickets, bool(ok)))
+        now = self.clock()
+        for tickets, ok in resolved:
+            for t in tickets:
+                self._note_late(t, now)
+                self._resolve(t, ok)

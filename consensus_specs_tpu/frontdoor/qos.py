@@ -1,0 +1,147 @@
+"""QoS vocabulary of the admission plane: priority classes, per-tenant
+token buckets, and the typed Overloaded refusal.
+
+Priority is a total order over the four beacon-API request classes —
+block-proposal work outranks attestation verification outranks head
+queries outranks light-client reads — enforced twice: at admission (the
+shed ladder degrades reads before writes, never the other way) and at
+flush sealing (the door's scheduler orders multi-class flushes by the
+same ranks via `class_priority`).
+
+Token buckets refill on an INJECTED clock (`clock=time.monotonic` by
+default): the traffic replay drives a virtual clock, so quota exhaustion
+is a deterministic function of the script, not of host scheduling — the
+property the chaos-vs-oracle bit-identity tests stand on.
+
+jax-free at module level by charter (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+# -- priority classes ---------------------------------------------------------
+
+BLOCK_PROPOSAL = "block_proposal"
+ATTESTATION_VERIFY = "attestation_verify"
+HEAD_QUERY = "head_query"
+LIGHT_CLIENT_READ = "light_client_read"
+
+# rank 0 is most urgent; admission, the shed ladder, and flush sealing all
+# read this one map so the order cannot drift between layers
+PRIORITY = {
+    BLOCK_PROPOSAL: 0,
+    ATTESTATION_VERIFY: 1,
+    HEAD_QUERY: 2,
+    LIGHT_CLIENT_READ: 3,
+}
+CLASSES = tuple(PRIORITY)
+
+# the classes the shed ladder may refuse under pressure, least-critical
+# first; anything not listed here (the write lanes) NEVER pressure-sheds
+SHEDDABLE = (LIGHT_CLIENT_READ, HEAD_QUERY)
+
+
+@dataclass(frozen=True)
+class Overloaded:
+    """Typed fast-fail verdict for a refused request.
+
+    reason    "shed" (pressure ladder), "quota_exhausted" (tenant bucket
+              empty), or "deadline_missed" (expired before admission).
+    klass     the refused request class.
+    tenant    the refused tenant.
+    retry_after_s  the caller's backoff hint: roughly when the refusal
+              cause should have cleared (bucket refill time, or one pump
+              interval for pressure sheds).
+    """
+
+    reason: str
+    klass: str
+    tenant: str
+    retry_after_s: float = 0.0
+
+
+# -- per-tenant token buckets -------------------------------------------------
+
+
+class TokenBucket:
+    """Classic token bucket: `capacity` tokens, `refill_per_s` continuous
+    refill, lazily applied on the injected clock at each take()."""
+
+    __slots__ = ("capacity", "refill_per_s", "_clock", "_tokens", "_t_last")
+
+    def __init__(self, capacity: float, refill_per_s: float,
+                 clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        if refill_per_s < 0:
+            raise ValueError("refill_per_s must be non-negative")
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._tokens = float(capacity)
+        self._t_last = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        dt = now - self._t_last
+        if dt > 0:
+            self._tokens = min(self.capacity,
+                               self._tokens + dt * self.refill_per_s)
+        self._t_last = now
+
+    def take(self, n: float = 1.0) -> bool:
+        """Spend `n` tokens; False (and no spend) when the bucket holds
+        fewer — the quota_exhausted signal."""
+        self._refill()
+        if self._tokens + 1e-12 < n:
+            return False
+        self._tokens -= n
+        return True
+
+    def level(self) -> float:
+        self._refill()
+        return self._tokens
+
+    def time_to_tokens(self, n: float = 1.0) -> float:
+        """Seconds until the bucket holds `n` tokens (0 when it already
+        does; inf with refill off) — the Overloaded retry_after_s hint."""
+        self._refill()
+        missing = n - self._tokens
+        if missing <= 0:
+            return 0.0
+        if self.refill_per_s == 0:
+            return float("inf")
+        return missing / self.refill_per_s
+
+
+class TenantQuotas:
+    """One token bucket per tenant, created on first sight with the
+    default shape; per-tenant overrides via set_quota (a paid tier, or a
+    deliberately starved hostile tenant in tests)."""
+
+    def __init__(self, capacity: float = 256.0, refill_per_s: float = 64.0,
+                 *, clock=time.monotonic):
+        self.default_capacity = float(capacity)
+        self.default_refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._buckets: dict = {}
+
+    def set_quota(self, tenant: str, capacity: float,
+                  refill_per_s: float) -> None:
+        self._buckets[tenant] = TokenBucket(
+            capacity, refill_per_s, clock=self._clock)
+
+    def bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = TokenBucket(self.default_capacity, self.default_refill_per_s,
+                            clock=self._clock)
+            self._buckets[tenant] = b
+        return b
+
+    def take(self, tenant: str, n: float = 1.0) -> bool:
+        return self.bucket(tenant).take(n)
+
+    def tenants(self) -> list:
+        return sorted(self._buckets)

@@ -1,0 +1,204 @@
+"""Seeded traffic scripts for the admission plane, on the scenario
+engine's plan-then-replay machinery.
+
+Like scenarios/script.py, a script here is a PURE PLAN: `build_script`
+derives every step — arrival time, tenant, request class, payload ref —
+from one `Random(f"traffic:{seed}:{profile}")` stream, with no I/O and no
+wall clock, so the same (profile, seed) always yields the same step
+sequence. Replay then drives a FrontDoor under a `VirtualClock`: the
+clock advances exactly to each step's virtual arrival time, which makes
+quota refill, deadline math, and EDF sealing deterministic functions of
+the script. That is the property the chaos lanes stand on — a replay
+under seeded faults at `frontdoor.admit`/`frontdoor.shed`/`sched.dispatch`
+must produce bit-identical outcomes to the fault-free oracle replay.
+
+Three profiles, each a release-gated lane (slo.json):
+
+  diurnal         the boring day: a smooth sinusoidal load swing around
+                  the base rate, every class in its steady mix.
+  flash_crowd     epoch boundary: the steady mix plus a 6x attestation
+                  burst through the middle tenth of the run — the EDF
+                  sealing and write-lane backpressure stressor.
+  hostile_tenant  one tenant ("mallory") submits at 10x its fair share
+                  across every class while the honest tenants keep the
+                  steady mix — the quota + shed-ladder stressor. The
+                  acceptance bar: honest p99 holds, zero attestation
+                  sheds, mallory eats quota_exhausted.
+
+jax-free at module level by charter (tpulint import-layering).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from random import Random
+
+from .qos import (
+    ATTESTATION_VERIFY,
+    BLOCK_PROPOSAL,
+    HEAD_QUERY,
+    LIGHT_CLIENT_READ,
+    Overloaded,
+)
+
+PROFILES = ("diurnal", "flash_crowd", "hostile_tenant")
+
+# steady-state class mix: mostly writes (the gossip firehose), a healthy
+# read/head share, the occasional proposal — cumulative thresholds over
+# one rng.random() draw, so the mix costs one stream element per step
+_MIX = (
+    (ATTESTATION_VERIFY, 0.55),
+    (HEAD_QUERY, 0.75),
+    (LIGHT_CLIENT_READ, 0.97),
+    (BLOCK_PROPOSAL, 1.0),
+)
+
+_TICK_S = 0.025  # arrival-planning granularity (virtual seconds)
+
+
+@dataclass(frozen=True)
+class TrafficStep:
+    """One planned request: virtual arrival time, tenant, class, and a
+    payload selector (`ref`) the materializer maps to concrete bytes."""
+
+    t: float
+    tenant: str
+    klass: str
+    ref: int
+
+
+@dataclass(frozen=True)
+class TrafficScript:
+    profile: str
+    seed: int
+    duration_s: float
+    tenants: tuple
+    steps: tuple
+
+
+def _pick_class(rng: Random) -> str:
+    x = rng.random()
+    for klass, ceil in _MIX:
+        if x <= ceil:
+            return klass
+    return BLOCK_PROPOSAL
+
+
+def build_script(profile: str, seed: int = 0, *, duration_s: float = 8.0,
+                 base_rate: float = 60.0,
+                 tenants=("alice", "bob", "carol")) -> TrafficScript:
+    """Plan one profile's request schedule. `base_rate` is total honest
+    requests/second across `tenants`; hostile_tenant adds "mallory" at
+    10x one honest tenant's share ON TOP of it."""
+    if profile not in PROFILES:
+        raise ValueError(f"unknown profile {profile!r} "
+                         f"(profiles: {PROFILES})")
+    rng = Random(f"traffic:{seed}:{profile}")
+    tenants = tuple(tenants)
+    fair_share = base_rate / len(tenants)
+    steps = []
+    ref = 0
+    t = 0.0
+    while t < duration_s:
+        # honest load for this tick
+        rate = base_rate
+        if profile == "diurnal":
+            # one full day compressed into the run: ±45% swing
+            rate = base_rate * (1.0 + 0.45 * math.sin(
+                2.0 * math.pi * t / duration_s))
+        expected = rate * _TICK_S
+        n = int(expected) + (1 if rng.random() < (expected % 1.0) else 0)
+        for _ in range(n):
+            klass = _pick_class(rng)
+            if profile == "flash_crowd" and 0.45 <= t / duration_s < 0.55:
+                # epoch boundary: the middle tenth is an attestation wave
+                # 6x the steady write rate, same tenants
+                for _ in range(6):
+                    steps.append(TrafficStep(
+                        t=round(t + rng.random() * _TICK_S, 6),
+                        tenant=rng.choice(tenants),
+                        klass=ATTESTATION_VERIFY, ref=ref))
+                    ref += 1
+            steps.append(TrafficStep(
+                t=round(t + rng.random() * _TICK_S, 6),
+                tenant=rng.choice(tenants), klass=klass, ref=ref))
+            ref += 1
+        if profile == "hostile_tenant":
+            hostile = 10.0 * fair_share * _TICK_S
+            n_bad = int(hostile) + (1 if rng.random() < (hostile % 1.0)
+                                    else 0)
+            for _ in range(n_bad):
+                steps.append(TrafficStep(
+                    t=round(t + rng.random() * _TICK_S, 6),
+                    tenant="mallory", klass=_pick_class(rng), ref=ref))
+                ref += 1
+        t = round(t + _TICK_S, 6)
+    steps.sort(key=lambda s: (s.t, s.ref))
+    all_tenants = tenants + (("mallory",) if profile == "hostile_tenant"
+                             else ())
+    return TrafficScript(profile=profile, seed=seed, duration_s=duration_s,
+                         tenants=all_tenants, steps=tuple(steps))
+
+
+class VirtualClock:
+    """Deterministic monotonic clock for replay: time moves only when the
+    driver advances it. Callable, so it drops into every `clock=` seam
+    (door, quotas, scheduler, retry)."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("virtual time cannot rewind")
+        self._now += dt
+        return self._now
+
+    def advance_to(self, t: float) -> float:
+        if t > self._now:
+            self._now = float(t)
+        return self._now
+
+
+def replay(script: TrafficScript, door, materialize, clock: VirtualClock):
+    """Drive every scripted step through the door at its virtual arrival
+    time, then drain. `materialize(step) -> (payload, degraded_ok)` maps
+    refs to concrete payloads (test/bench-owned, so the script itself
+    stays byte-pure). Returns the [(step, ticket)] list in script order."""
+    out = []
+    for step in script.steps:
+        clock.advance_to(step.t)
+        payload, degraded_ok = materialize(step)
+        out.append((step, door.submit(step.tenant, step.klass, payload,
+                                      degraded_ok=degraded_ok)))
+    door.drain()
+    return out
+
+
+def outcome(ticket) -> tuple:
+    """Canonical comparable form of one ticket's verdict — the unit of the
+    chaos-vs-oracle bit-identity assertion. Branch tuples hash to their
+    concatenated bytes so giant proofs compare cheaply."""
+    v = ticket._value
+    if isinstance(v, Overloaded):
+        return ("overloaded", v.reason, v.klass, v.tenant)
+    if isinstance(v, bool):
+        return ("verdict", v)
+    if isinstance(v, bytes):
+        return ("root", v.hex())
+    if isinstance(v, tuple):
+        return ("branch", b"".join(bytes(s) for s in v).hex())
+    return ("value", repr(v))
+
+
+def outcomes(tickets) -> list:
+    """[(step ref, outcome)] for a replay's return value, script-ordered."""
+    return [(step.ref, outcome(t)) for step, t in tickets]

@@ -86,6 +86,23 @@ class ProofService:
     def prove(self, column: str, gindex: int) -> tuple:
         return self.prove_many([(column, gindex)])[0]
 
+    def prove_host(self, column: str, gindex: int) -> tuple:
+        """Degraded read: serve the branch from the host `build_chunk_proof`
+        oracle, bypassing cache and scheduler entirely. This is the shed
+        ladder's light-client fallback (frontdoor): when the device lanes
+        are saturated, a caller that opted into degraded reads still gets a
+        bit-identical branch — build_chunk_proof is the same oracle the
+        multiproof kernel is pinned against — it just pays host latency and
+        never warms the cache."""
+        if column not in self._providers:
+            raise KeyError(f"unregistered proof column {column!r}")
+        from ..ssz.proofs import build_chunk_proof
+
+        chunks = [bytes(c) for c in self._providers[column]()]
+        branch = tuple(build_chunk_proof(chunks, int(gindex)))
+        self.registry.counter("proof_degraded_reads_total").inc()
+        return branch
+
     def prove_many(self, queries) -> list:
         """One branch (deepest-first tuple of 32-byte siblings) per
         (column, gindex) query, in input order; cache hits answer

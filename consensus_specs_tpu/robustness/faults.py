@@ -8,7 +8,10 @@ peer), the verification scheduler's dispatch (`sched.dispatch` — the
 seam every BLS/KZG/Merkle batch crosses in sched/scheduler.py), and the
 attestation firehose's three stages (`firehose.ingest`,
 `firehose.aggregate`, `firehose.flush` — the streaming
-gossip→aggregate→flush pipeline in firehose/pipeline.py). A
+gossip→aggregate→flush pipeline in firehose/pipeline.py). The admission
+plane adds two more (`frontdoor.admit`, `frontdoor.shed` — the QoS
+front door in frontdoor/admission.py), so hostile-traffic chaos lanes
+can fault the admission decision itself. A
 `FaultPlan` injects failures at exactly those seams — the hooks live in
 the PRODUCTION code paths (engine/bridge.py, engine/resident.py,
 parallel/gossip_driver.py, crypto/bls.py, sched/scheduler.py,

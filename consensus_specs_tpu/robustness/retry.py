@@ -93,11 +93,22 @@ PROBE_POLICY = RetryPolicy(max_attempts=1)
 def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None, *,
                     classify: Callable = is_retryable,
                     sleep: Callable = time.sleep,
-                    on_retry: Optional[Callable] = None):
+                    on_retry: Optional[Callable] = None,
+                    deadline: Optional[float] = None,
+                    clock: Callable = time.monotonic):
     """Run `fn()` under `policy`; re-raise the final failure unchanged.
 
     `classify(exc)` decides retry-vs-raise; `on_retry(attempt, exc)` runs
-    before each backoff sleep (logging / provenance hooks)."""
+    before each backoff sleep (logging / provenance hooks).
+
+    `deadline` (absolute, in `clock`'s timebase) makes the retry loop
+    deadline-aware: once the next backoff sleep would land at or past the
+    deadline, the budget cannot fit another attempt and the LAST error is
+    raised immediately instead of being burned on doomed backoff — this is
+    how front-door deadlines propagate through every retried seam. The
+    backoff delay is computed before the check, so the jitter RNG stream
+    (and therefore every retried schedule) is identical with or without a
+    deadline."""
     policy = policy or DEVICE_POLICY
     rng = Random(policy.seed)
     attempt = 0
@@ -113,6 +124,12 @@ def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None, *,
                         "retries_exhausted_total",
                         error=type(exc).__name__).inc()
                 raise
+            delay = policy.delay(attempt, rng)
+            if deadline is not None and clock() + delay >= deadline:
+                _obs_metrics.REGISTRY.counter(
+                    "retries_deadline_exhausted_total",
+                    error=type(exc).__name__).inc()
+                raise
             # One tick per absorbed failure, labeled by exception type: the
             # chaos lane reconciles these against the fault plan's per-site
             # fire counts (each retried fire is caught exactly once here).
@@ -121,4 +138,4 @@ def call_with_retry(fn: Callable, policy: Optional[RetryPolicy] = None, *,
             _obs_trace.annotate(retried_errors=type(exc).__name__)
             if on_retry is not None:
                 on_retry(attempt, exc)
-            sleep(policy.delay(attempt, rng))
+            sleep(delay)

@@ -26,9 +26,11 @@ from .classes import (  # noqa: F401
 )
 from .scheduler import (  # noqa: F401
     DISPATCH_RETRY_POLICY,
+    EdfSealPolicy,
     SchedResultIntegrityError,
     SchedSelfCheckError,
     Scheduler,
+    SealPolicy,
     default_scheduler,
     reset_default_scheduler,
 )

@@ -35,6 +35,12 @@ class Request:
     kind: str
     payload: tuple
     group_key: Optional[Hashable] = None
+    # deadline: absolute time (in the owning scheduler's clock space —
+    # time.monotonic unless the scheduler was built with an injected clock)
+    # by which the submitter wants a verdict. The scheduler never rejects
+    # on it; it only feeds the seal policy's EDF ordering (scheduler.py),
+    # so a deadline-free request behaves exactly as before.
+    deadline: Optional[float] = None
     # trace: the submitter's TraceContext (obs/context.py), when tracing is
     # on. The scheduler never reads it for scheduling decisions — it only
     # links the dispatch/reverify spans back to every member request, and

@@ -12,6 +12,11 @@ multiplexes them behind one dispatch seam:
     flushes any class whose oldest entry has waited too long, checked at
     every admission. Classes may opt into same-key collapse at admission
     (the Wonderboom FastAggregateVerify merge — see classes.BlsWorkClass).
+    Installing a `SealPolicy` replaces both built-in triggers: the policy
+    alone decides which classes seal after each admission (`EdfSealPolicy`
+    is earliest-deadline-first over `Request.deadline` — the front door's
+    sealing discipline), and `class_priority` orders multi-class
+    flush/drain passes so the proposal lane dispatches before reads.
   * dispatch: one batch per class per flush, executed behind the
     `sched.dispatch` fault seam with the PR-5 retry policy; results are
     validated (row count + dtype) so corrupt-kind chaos faults retry
@@ -74,13 +79,75 @@ class SchedSelfCheckError(_faults.IntegrityError):
 class _Entry:
     """One queue slot: the requests collapsed into it and their handles."""
 
-    __slots__ = ("members", "handles", "collapsed", "t_submit")
+    __slots__ = ("members", "handles", "collapsed", "t_submit", "deadline")
 
     def __init__(self, request: Request, handle: Handle, now: float):
         self.members = [request]
         self.handles = [handle]
         self.collapsed = request  # the request dispatch actually executes
         self.t_submit = now
+        self.deadline = request.deadline
+
+    def note_deadline(self, request: Request) -> None:
+        """Fold a merged member's deadline in: the entry owes its verdict
+        by the EARLIEST member deadline (a collapse must not let a tight
+        request inherit a lax neighbour's slack)."""
+        d = request.deadline
+        if d is not None and (self.deadline is None or d < self.deadline):
+            self.deadline = d
+
+
+class SealPolicy:
+    """Seam deciding WHICH queued classes to seal after an admission.
+
+    Installed on a Scheduler via `seal_policy=`, `select(scheduler, now)`
+    runs after every submit/submit_many admission (outside the queue lock)
+    and returns the class names to flush, in flush order. It REPLACES the
+    built-in depth/deadline triggers — a policy that wants depth
+    backpressure must implement it (EdfSealPolicy does)."""
+
+    def select(self, scheduler: "Scheduler", now: float) -> list:
+        raise NotImplementedError
+
+
+class EdfSealPolicy(SealPolicy):
+    """Earliest-deadline-first sealing: seal the batch whose earliest
+    deadline is closest to expiry.
+
+    A class becomes due when its earliest queued deadline is within
+    `slack_s` of `now` (the slack covers dispatch time so the verdict — not
+    just the flush — lands inside the deadline), when its depth reaches the
+    depth limit (backpressure, same bound the built-in trigger used), or —
+    for deadline-free entries — when its oldest entry has waited
+    `max_wait_s`. Due classes flush earliest-deadline-first; deadline-free
+    overflow follows, oldest-first."""
+
+    def __init__(self, slack_s: float = 0.0, *,
+                 max_wait_s: float | None = None,
+                 depth_limit: int | None = None):
+        self.slack_s = slack_s
+        self.max_wait_s = max_wait_s
+        self.depth_limit = depth_limit
+
+    def select(self, scheduler: "Scheduler", now: float) -> list:
+        due = []
+        for name, wc in scheduler.classes.items():
+            depth, oldest, earliest = scheduler.queue_meta(name)
+            if not depth:
+                continue
+            limit = self.depth_limit
+            if limit is None:
+                limit = (wc.max_depth if wc.max_depth is not None
+                         else scheduler.max_depth)
+            if earliest is not None and earliest - now <= self.slack_s:
+                due.append((earliest, name))
+            elif depth >= limit:
+                due.append((now, name))
+            elif (self.max_wait_s is not None and oldest is not None
+                  and now - oldest >= self.max_wait_s):
+                due.append((oldest + self.max_wait_s, name))
+        due.sort()
+        return [name for _, name in due]
 
 
 class Scheduler:
@@ -90,12 +157,24 @@ class Scheduler:
                  failure_threshold: int = 3,
                  max_depth: int = DEFAULT_MAX_DEPTH,
                  flush_deadline_s: float | None = None,
+                 seal_policy: SealPolicy | None = None,
+                 class_priority: dict | None = None,
+                 clock=time.monotonic,
                  registry=None):
         self.classes = {wc.name: wc for wc in
                         (default_classes() if classes is None else classes)}
         self.retry_policy = retry_policy or DISPATCH_RETRY_POLICY
         self.max_depth = max_depth
         self.flush_deadline_s = flush_deadline_s
+        # seal_policy: when set, it owns the "when do we flush" decision
+        # entirely (depth/deadline triggers are bypassed). class_priority
+        # maps name -> rank (lower flushes first) and orders multi-class
+        # flush()/drain() passes; unranked classes keep admission order
+        # after every ranked one. clock is injectable so deadline math is
+        # deterministic under a virtual clock (frontdoor traffic replay).
+        self.seal_policy = seal_policy
+        self.class_priority = class_priority
+        self.clock = clock
         self.registry = registry if registry is not None else _obs_metrics.REGISTRY
         self._breakers = {
             name: _breaker.CircuitBreaker(
@@ -114,6 +193,27 @@ class Scheduler:
         with self._lock:
             return len(self._queues[work_class])
 
+    def queue_meta(self, work_class: str) -> tuple:
+        """(depth, oldest_t_submit, earliest_deadline) for one class queue
+        — the seal policy's decision inputs. Empty queue: (0, None, None);
+        a queue whose entries carry no deadline reports earliest None."""
+        with self._lock:
+            queue = self._queues[work_class]
+            if not queue:
+                return 0, None, None
+            deadlines = [e.deadline for e in queue if e.deadline is not None]
+            return (len(queue), queue[0].t_submit,
+                    min(deadlines) if deadlines else None)
+
+    def _ordered(self, names) -> list:
+        """Flush order for a multi-class pass: class_priority rank when
+        installed (stable within a rank), registration order otherwise."""
+        names = list(names)
+        if self.class_priority is None:
+            return names
+        rank = self.class_priority
+        return sorted(names, key=lambda n: rank.get(n, len(rank)))
+
     def queue_load(self, work_class: str) -> tuple:
         """(entries, members) currently queued: distinct device checks vs
         the requests collapsed into them. members/entries is the live
@@ -131,7 +231,7 @@ class Scheduler:
         if request.kind not in wc.kinds:
             raise ValueError(f"unknown kind {request.kind!r} for work class "
                              f"{wc.name!r} (kinds: {wc.kinds})")
-        now = time.monotonic()
+        now = self.clock()
         handle = Handle(request, self, _submitted_at=now)
         reg = self.registry
         with self._lock:
@@ -139,6 +239,9 @@ class Scheduler:
         reg.counter("sched_submitted_total",
                     work_class=wc.name, kind=request.kind).inc()
         reg.gauge("sched_queue_depth", work_class=wc.name).set(depth)
+        if self.seal_policy is not None:
+            self._run_seal_policy(now)
+            return handle
         limit = wc.max_depth if wc.max_depth is not None else self.max_depth
         if depth >= limit:
             self._flush_class(wc.name, trigger="depth")
@@ -160,7 +263,7 @@ class Scheduler:
         """
         if not requests:
             return []
-        now = time.monotonic()
+        now = self.clock()
         handles: list[Handle] = []
         per_class: dict = {}
         for request in requests:
@@ -185,13 +288,21 @@ class Scheduler:
                 reg.counter("sched_submitted_total",
                             work_class=name, kind=request.kind).inc()
             reg.gauge("sched_queue_depth", work_class=name).set(depths[name])
+            if self.seal_policy is not None:
+                continue
             wc = self.classes[name]
             limit = wc.max_depth if wc.max_depth is not None else self.max_depth
             if depths[name] >= limit:
                 self._flush_class(name, trigger="depth")
-        if self.flush_deadline_s is not None:
-            self._flush_overdue(time.monotonic())
+        if self.seal_policy is not None:
+            self._run_seal_policy(self.clock())
+        elif self.flush_deadline_s is not None:
+            self._flush_overdue(self.clock())
         return handles
+
+    def _run_seal_policy(self, now: float) -> None:
+        for name in self.seal_policy.select(self, now):
+            self._flush_class(name, trigger="seal")
 
     def _admit_batch(self, wc, pairs: list, now: float) -> int:
         """Admit (request, handle) pairs for one class under the held lock."""
@@ -229,6 +340,7 @@ class Scheduler:
                 for request, handle in rest:
                     entry.members.append(request)
                     entry.handles.append(handle)
+                    entry.note_deadline(request)
                     self.registry.counter(
                         "sched_collapsed_total", work_class=wc.name).inc()
                 entry.collapsed = merged
@@ -251,6 +363,7 @@ class Scheduler:
                 if merged is not None:
                     entry.members.append(request)
                     entry.handles.append(handle)
+                    entry.note_deadline(request)
                     entry.collapsed = merged
                     self.registry.counter(
                         "sched_collapsed_total", work_class=wc.name).inc()
@@ -278,7 +391,8 @@ class Scheduler:
         """Dispatch everything queued (for one class, or all of them).
         `trigger` only labels the sched_flush_total series — streaming
         callers (the firehose worker) tag their flushes distinctly."""
-        names = [work_class] if work_class is not None else list(self.classes)
+        names = ([work_class] if work_class is not None
+                 else self._ordered(self.classes))
         for name in names:
             self._flush_class(name, trigger=trigger)
 
@@ -290,7 +404,7 @@ class Scheduler:
                 pending = [n for n, q in self._queues.items() if q]
             if not pending:
                 return
-            for name in pending:
+            for name in self._ordered(pending):
                 self._flush_class(name, trigger="drain")
 
     def _flush_class(self, name: str, trigger: str) -> None:
@@ -382,7 +496,7 @@ class Scheduler:
     def _resolve(self, wc, entries: list, results, degraded: bool) -> None:
         lat = self.registry.histogram(
             "sched_submit_latency_seconds", work_class=wc.name)
-        now = time.monotonic()
+        now = self.clock()
 
         def _ex(h):
             tr = h.request.trace

@@ -1,0 +1,9 @@
+"""Seeded failure shape: an admission plane importing the device stack at
+module level — every jax-free consumer (the traffic replay, the obs dump,
+the SLO probe) would drag jax in just by asking whether a request may be
+admitted."""
+import jax  # noqa  tpulint-expect: import-layering
+
+
+def admit(klass, payload):
+    return jax.device_put(payload)

@@ -1,0 +1,19 @@
+"""Clean admission-plane module: jax-free at module level, the frontdoor/
+charter — the door decides admission, quotas, and shedding on the host
+and only ever reaches the device through the fronted lanes' scheduler
+submits; any direct device peek stays deferred behind the dispatch."""
+
+queues = {"reads": [], "heads": []}
+
+
+def admit(klass, payload):
+    queues[klass].append(payload)
+    return len(queues[klass])
+
+
+def serve(snapshot, use_device=False):
+    if use_device:
+        from .. import ops  # deferred: only the dispatch path pays
+
+        return ops.head(snapshot)
+    return queues["heads"][-1] if queues["heads"] else None

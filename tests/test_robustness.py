@@ -390,3 +390,129 @@ print("ROBUSTNESS-NO-JAX-OK")
         timeout=300)
     assert res.returncode == 0, res.stderr
     assert "ROBUSTNESS-NO-JAX-OK" in res.stdout
+
+
+# --- deadline-aware retry (the front-door admission budget) ------------------
+
+
+def test_call_with_retry_deadline_stops_doomed_backoff():
+    """Once the next backoff sleep would land past the deadline, the LAST
+    error surfaces immediately instead of burning the budget on sleeps
+    that cannot help."""
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    t = [0.0]
+    slept = []
+    calls = {"n": 0}
+
+    def sleep(d):
+        slept.append(d)
+        t[0] += d
+
+    def always_down():
+        calls["n"] += 1
+        raise TransientFault("device away")
+
+    base = obs_metrics.REGISTRY.counter_value(
+        "retries_deadline_exhausted_total", error="TransientFault")
+    with pytest.raises(TransientFault):
+        call_with_retry(
+            always_down,
+            RetryPolicy(max_attempts=10, base_delay=1.0, backoff=2.0,
+                        max_delay=60.0, jitter=0.0),
+            sleep=sleep, deadline=4.0, clock=lambda: t[0])
+    # delays 1s, 2s are affordable (land at t=1, t=3); the third delay
+    # (4s) would land at t=7 >= deadline 4 -> raise after 3 attempts
+    assert slept == [1.0, 2.0] and calls["n"] == 3
+    assert obs_metrics.REGISTRY.counter_value(
+        "retries_deadline_exhausted_total",
+        error="TransientFault") - base == 1
+
+
+def test_call_with_retry_deadline_leaves_jitter_stream_untouched():
+    """The backoff delay is computed BEFORE the deadline check, so adding
+    a (generous) deadline must not shift a single jittered sleep — the
+    chaos-replay bit-identity contract."""
+
+    def run(deadline):
+        calls = {"n": 0}
+        slept = []
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise TransientFault("not yet")
+            return "ok"
+
+        out = call_with_retry(
+            flaky,
+            RetryPolicy(max_attempts=5, base_delay=0.1, backoff=2.0,
+                        max_delay=1.0, jitter=0.5, seed=7),
+            sleep=slept.append, deadline=deadline, clock=lambda: 0.0)
+        assert out == "ok"
+        return slept
+
+    no_deadline = run(None)
+    with_deadline = run(1e9)
+    assert no_deadline == with_deadline and len(no_deadline) == 3
+
+
+def test_call_with_retry_deadline_allows_fitting_attempts():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientFault("x")
+        return "done"
+
+    assert call_with_retry(
+        flaky, RetryPolicy(max_attempts=5, base_delay=0.0, max_delay=0.0,
+                           jitter=0.0),
+        sleep=lambda d: None, deadline=10.0, clock=lambda: 0.0) == "done"
+    assert calls["n"] == 3
+
+
+# --- breaker: the half-open probe is single under concurrency ----------------
+
+
+def test_breaker_half_open_single_probe_under_concurrency():
+    """Four threads race on_attempt() at the open->half_open boundary:
+    every one gets probe mode (half-open means single-ATTEMPT, not
+    single-caller), but the transition — and its half_open_probe event —
+    happens exactly once per open, every round."""
+    import threading
+
+    from consensus_specs_tpu.obs import metrics as obs_metrics
+
+    brk = CircuitBreaker(failure_threshold=1, name="probe-race")
+    base = obs_metrics.REGISTRY.counter_value(
+        "breaker_events_total", breaker="probe-race",
+        event="half_open_probe")
+    rounds = 20
+    for _ in range(rounds):
+        brk.record_failure()
+        assert brk.state == rbreaker.OPEN
+        barrier = threading.Barrier(4)
+        modes = []
+        lock = threading.Lock()
+
+        def attempt():
+            barrier.wait()  # maximize the race on the transition
+            mode = brk.on_attempt()
+            with lock:
+                modes.append(mode)
+
+        threads = [threading.Thread(target=attempt) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert modes == ["probe"] * 4
+        probes = [e for e in brk.events if e["event"] == "half_open_probe"]
+        assert len(probes) == 1  # the regression bar: never 0, never 2+
+        brk.record_success()
+        brk.events.clear()
+    assert obs_metrics.REGISTRY.counter_value(
+        "breaker_events_total", breaker="probe-race",
+        event="half_open_probe") - base == rounds

@@ -442,3 +442,137 @@ def test_queue_load_tracks_entries_vs_members():
     assert sch.queue_depth("echo") == 2
     sch.drain()
     assert sch.queue_load("echo") == (0, 0)
+
+
+# --- seal policy seam: EDF sealing + class priority (frontdoor) --------------
+
+
+class _SealProbe(WorkClass):
+    """Minimal lane for seal-order assertions: every dispatch appends
+    (lane, batch size) to a log shared across the scheduler's classes."""
+
+    kinds = ("echo",)
+
+    def __init__(self, name, log):
+        self.name = name
+        self.log = log
+
+    def execute(self, requests):
+        self.log.append((self.name, len(requests)))
+        return np.asarray([True] * len(requests), dtype=bool)
+
+    def execute_degraded(self, requests):
+        return self.execute(requests)
+
+
+def _probe_req(lane, deadline=None):
+    return Request(work_class=lane, kind="echo", payload=(),
+                   deadline=deadline)
+
+
+def test_seal_policy_replaces_builtin_triggers_and_seals_edf():
+    """With a SealPolicy installed the built-in deadline trigger is
+    bypassed (flush_deadline_s=0.0 would otherwise flush every submit),
+    and when several lanes come due in one admission they seal
+    earliest-deadline-first."""
+    from consensus_specs_tpu.sched import EdfSealPolicy
+
+    t = [0.0]
+    log = []
+    a, b = _SealProbe("a_lane", log), _SealProbe("b_lane", log)
+    sch = Scheduler(classes=[a, b], flush_deadline_s=0.0,
+                    seal_policy=EdfSealPolicy(slack_s=0.0),
+                    clock=lambda: t[0])
+    h1 = sch.submit(_probe_req("a_lane", deadline=6.0))
+    h2 = sch.submit(_probe_req("b_lane", deadline=5.0))
+    assert log == [] and not h1.done()  # builtin trigger did NOT fire
+    t[0] = 10.0  # both lanes overdue
+    h3 = sch.submit(_probe_req("a_lane", deadline=30.0))
+    # one admission sealed both: b first (earliest deadline 5.0 < 6.0),
+    # and a's flush swept the just-admitted request in with it
+    assert log == [("b_lane", 1), ("a_lane", 2)]
+    assert h1.done() and h2.done() and h3.done()
+    assert REG.counter_value("sched_flush_total", work_class="b_lane",
+                             trigger="seal") >= 1
+
+
+def test_seal_policy_depth_limit_provides_backpressure():
+    from consensus_specs_tpu.sched import EdfSealPolicy
+
+    log = []
+    wc = _SealProbe("a_lane", log)
+    sch = Scheduler(classes=[wc],
+                    seal_policy=EdfSealPolicy(slack_s=0.0, depth_limit=3),
+                    clock=lambda: 0.0)
+    for _ in range(2):
+        sch.submit(_probe_req("a_lane", deadline=99.0))
+    assert log == []  # under the limit, deadline far: keep packing
+    sch.submit(_probe_req("a_lane", deadline=99.0))
+    assert log == [("a_lane", 3)]  # depth limit seals the batch
+
+
+def test_seal_policy_max_wait_seals_deadline_free_entries():
+    from consensus_specs_tpu.sched import EdfSealPolicy
+
+    t = [0.0]
+    log = []
+    wc = _SealProbe("a_lane", log)
+    sch = Scheduler(classes=[wc],
+                    seal_policy=EdfSealPolicy(slack_s=0.0, max_wait_s=1.0),
+                    clock=lambda: t[0])
+    sch.submit(_probe_req("a_lane"))  # no deadline at all
+    assert log == []
+    t[0] = 1.5
+    sch.submit(_probe_req("a_lane"))
+    assert log == [("a_lane", 2)]  # oldest waited past max_wait_s
+
+
+def test_queue_meta_reports_depth_oldest_and_earliest_deadline():
+    t = [42.0]
+    wc = EchoClass()
+    sch = Scheduler(classes=[wc], clock=lambda: t[0])
+    assert sch.queue_meta("echo") == (0, None, None)
+    sch.submit(_echo())
+    assert sch.queue_meta("echo") == (1, 42.0, None)  # no deadlines yet
+    t[0] = 43.0
+    sch.submit(Request(work_class="echo", kind="echo", payload=(True,),
+                       deadline=50.0))
+    sch.submit(Request(work_class="echo", kind="echo", payload=(True,),
+                       deadline=45.0))
+    depth, oldest, earliest = sch.queue_meta("echo")
+    assert depth == 3 and oldest == 42.0 and earliest == 45.0
+    sch.drain()
+    assert sch.queue_meta("echo") == (0, None, None)
+
+
+def test_collapse_folds_min_member_deadline_into_entry():
+    """A collapsed entry inherits the TIGHTEST member deadline, so EDF
+    sealing can never starve an urgent request merged into a lazy one."""
+    wc = CollapsibleEcho()
+    sch = Scheduler(classes=[wc])
+    sch.submit(Request(work_class="echo", kind="echo",
+                       payload=(True, "k"), deadline=9.0))
+    sch.submit(Request(work_class="echo", kind="echo",
+                       payload=(True, "k"), deadline=4.0))
+    sch.submit(Request(work_class="echo", kind="echo",
+                       payload=(True, "k")))  # deadline-free rider
+    depth, _, earliest = sch.queue_meta("echo")
+    assert depth == 1 and earliest == 4.0
+    sch.drain()
+
+
+def test_class_priority_orders_multi_class_flush_and_drain():
+    log = []
+    lanes = [_SealProbe("alpha", log), _SealProbe("beta", log),
+             _SealProbe("gamma", log)]
+    sch = Scheduler(classes=lanes, class_priority={"gamma": 0, "alpha": 1})
+    for lane in ("alpha", "beta", "gamma"):
+        sch.submit(_probe_req(lane))
+    sch.flush()
+    # ranked lanes first (gamma then alpha), unranked keep admission order
+    assert log == [("gamma", 1), ("alpha", 1), ("beta", 1)]
+    log.clear()
+    for lane in ("beta", "gamma"):
+        sch.submit(_probe_req(lane))
+    sch.drain()
+    assert log == [("gamma", 1), ("beta", 1)]

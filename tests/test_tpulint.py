@@ -106,13 +106,15 @@ def test_layering_fixture():
     assert "bad_driver.py" in by_file  # scenarios/ module-level jax
     assert "bad_cache.py" in by_file  # proofs/ module-level jax
     assert "bad_service.py" in by_file  # forkchoice/ module-level jax
+    assert "bad_door.py" in by_file  # frontdoor/ module-level jax
     for clean in ("kzg_shim.py", "codec.py", "scenario.py", "retry.py",
                   "recompile.py",  # recompile: obs install-deferral pattern
                   "queue.py",  # sched: executor-deferral pattern
                   "stream.py",  # firehose: host-orchestrator pattern
                   "driver.py",  # scenarios: lane-deferral pattern
                   "cache.py",  # proofs: miss-path-deferral pattern
-                  "service.py"):  # forkchoice: dispatch-deferral pattern
+                  "service.py",  # forkchoice: dispatch-deferral pattern
+                  "door.py"):  # frontdoor: admission stays on the host
         assert clean not in by_file
 
 

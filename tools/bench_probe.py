@@ -108,6 +108,9 @@ def check_e2e_lane() -> int:
     rc = check_forkchoice_lane(extra)
     if rc:
         return rc
+    rc = check_frontdoor_lane(extra)
+    if rc:
+        return rc
     return check_obs_snapshot()
 
 
@@ -254,6 +257,38 @@ def check_forkchoice_lane(extra: dict) -> int:
           f"(heads={extra['forkchoice_heads_per_s']}/s, "
           f"lag_p99={extra['forkchoice_head_lag_p99_s']}s, "
           f"flips={extra['forkchoice_head_flips']})", file=sys.stderr)
+    return 0
+
+
+def check_frontdoor_lane(extra: dict) -> int:
+    """Refuse a record without the front-door admission lane: the
+    hostile-tenant honest p99 is the SLO series (a beacon API that melts
+    for honest callers when one tenant floods it has no front door), the
+    attestation-shed sum is the writes-never-shed invariant gated at
+    zero, and the mallory refusal count proves the quota gate actually
+    absorbed the hostile stream — a hostile lane where mallory was never
+    refused measured a friendly one."""
+    missing = [k for k in ("frontdoor_requests_per_s",
+                           "frontdoor_hostile_honest_p99_s",
+                           "frontdoor_attestation_sheds",
+                           "frontdoor_mallory_quota_refusals")
+               if k not in extra]
+    if missing:
+        print(f"# bench-probe: FATAL — bench record is missing the "
+              f"front-door admission lane (missing {missing}); fix "
+              f"benches/frontdoor_bench.run or its bench.py wiring",
+              file=sys.stderr)
+        return 3
+    if extra["frontdoor_mallory_quota_refusals"] <= 0:
+        print("# bench-probe: FATAL — the front-door hostile lane never "
+              "quota-refused the hostile tenant; the lane measured "
+              "friendly traffic", file=sys.stderr)
+        return 3
+    print(f"# bench-probe: frontdoor lane present "
+          f"(honest_p99={extra['frontdoor_hostile_honest_p99_s']}s, "
+          f"att_sheds={extra['frontdoor_attestation_sheds']}, "
+          f"mallory_refusals={extra['frontdoor_mallory_quota_refusals']})",
+          file=sys.stderr)
     return 0
 
 
